@@ -1,0 +1,145 @@
+"""Numerical parity of tpu_dist.nn ops/layers against torch CPU.
+
+The reference's numerical substrate is torch's ATen kernels
+(/root/reference/mpspawn_dist.py:11-43 ConvNet ops); these tests pin our
+XLA-lowered ops to the same math.
+"""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as tF
+
+import jax
+import jax.numpy as jnp
+
+from tpu_dist import nn
+from tpu_dist.nn import functional as F
+
+
+def to_nhwc(x_nchw: np.ndarray) -> np.ndarray:
+    return np.transpose(x_nchw, (0, 2, 3, 1))
+
+
+def to_nchw(x_nhwc: np.ndarray) -> np.ndarray:
+    return np.transpose(x_nhwc, (0, 3, 1, 2))
+
+
+def hwio_from_oihw(w: np.ndarray) -> np.ndarray:
+    return np.transpose(w, (2, 3, 1, 0))
+
+
+@pytest.mark.parametrize("stride,padding,kernel", [(1, 1, 5), (1, 0, 3), (2, 2, 3)])
+def test_conv2d_matches_torch(rng, stride, padding, kernel):
+    x = rng.standard_normal((4, 1 if kernel == 5 else 8, 14, 14)).astype(np.float32)
+    cin = x.shape[1]
+    w = rng.standard_normal((6, cin, kernel, kernel)).astype(np.float32)
+    b = rng.standard_normal((6,)).astype(np.float32)
+
+    ref = tF.conv2d(torch.tensor(x), torch.tensor(w), torch.tensor(b),
+                    stride=stride, padding=padding).numpy()
+    out = F.conv2d(jnp.asarray(to_nhwc(x)), jnp.asarray(hwio_from_oihw(w)),
+                   jnp.asarray(b), stride=stride, padding=padding)
+    np.testing.assert_allclose(to_nchw(np.asarray(out)), ref, atol=1e-4)
+
+
+@pytest.mark.parametrize("kernel,stride", [(2, 2), (2, 1), (3, 2)])
+def test_max_pool_matches_torch(rng, kernel, stride):
+    x = rng.standard_normal((2, 5, 13, 13)).astype(np.float32)
+    ref = tF.max_pool2d(torch.tensor(x), kernel, stride).numpy()
+    out = F.max_pool2d(jnp.asarray(to_nhwc(x)), kernel, stride)
+    np.testing.assert_allclose(to_nchw(np.asarray(out)), ref, atol=1e-6)
+
+
+def test_cross_entropy_matches_torch(rng):
+    logits = rng.standard_normal((16, 10)).astype(np.float32)
+    labels = rng.integers(0, 10, size=(16,))
+    ref = tF.cross_entropy(torch.tensor(logits), torch.tensor(labels)).item()
+    out = float(F.cross_entropy(jnp.asarray(logits), jnp.asarray(labels)))
+    assert abs(out - ref) < 1e-5
+
+
+def test_linear_matches_torch(rng):
+    x = rng.standard_normal((3, 7)).astype(np.float32)
+    w = rng.standard_normal((5, 7)).astype(np.float32)  # torch (out, in)
+    b = rng.standard_normal((5,)).astype(np.float32)
+    ref = tF.linear(torch.tensor(x), torch.tensor(w), torch.tensor(b)).numpy()
+    out = F.linear(jnp.asarray(x), jnp.asarray(w.T), jnp.asarray(b))
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5)
+
+
+def test_batchnorm_train_and_eval_match_torch(rng):
+    x = rng.standard_normal((4, 3, 6, 6)).astype(np.float32)
+    tbn = torch.nn.BatchNorm2d(3)
+    tbn.train()
+    ref_train = tbn(torch.tensor(x)).detach().numpy()
+    run_mean = tbn.running_mean.numpy().copy()
+    run_var = tbn.running_var.numpy().copy()
+
+    bn = nn.BatchNorm2d(3)
+    params = bn.init(jax.random.key(0))  # weight=1, bias=0 matches torch init
+    state = bn.init_state()
+    out, new_state = bn.apply(params, jnp.asarray(to_nhwc(x)), state=state,
+                              training=True)
+    np.testing.assert_allclose(to_nchw(np.asarray(out)), ref_train, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(new_state[""]["mean"]), run_mean,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(new_state[""]["var"]), run_var,
+                               atol=1e-4)
+
+    tbn.eval()
+    x2 = rng.standard_normal((4, 3, 6, 6)).astype(np.float32)
+    ref_eval = tbn(torch.tensor(x2)).detach().numpy()
+    out2, _ = bn.apply(params, jnp.asarray(to_nhwc(x2)), state=new_state,
+                       training=False)
+    np.testing.assert_allclose(to_nchw(np.asarray(out2)), ref_eval, atol=1e-4)
+
+
+def test_dropout_train_eval():
+    x = jnp.ones((1000,))
+    drop = nn.Dropout(0.5)
+    y = drop.apply({}, x, training=True, rng=jax.random.key(0))
+    kept = float((y > 0).mean())
+    assert 0.4 < kept < 0.6
+    np.testing.assert_allclose(np.asarray(y[y > 0]), 2.0)  # inverted scaling
+    y_eval = drop.apply({}, x, training=False)
+    np.testing.assert_allclose(np.asarray(y_eval), np.asarray(x))
+
+
+def test_module_requires_apply():
+    lin = nn.Linear(3, 2)
+    with pytest.raises(RuntimeError):
+        lin(jnp.ones((1, 3)))
+
+
+def test_avg_pool_padded_matches_torch(rng):
+    x = rng.standard_normal((1, 3, 4, 4)).astype(np.float32)
+    ref = tF.avg_pool2d(torch.tensor(x), 2, 2, padding=1).numpy()
+    out = F.avg_pool2d(jnp.asarray(to_nhwc(x)), 2, 2, padding=1)
+    np.testing.assert_allclose(to_nchw(np.asarray(out)), ref, atol=1e-6)
+
+
+def test_weight_tying_shares_params():
+    lin = nn.Linear(4, 4)
+
+    class Tied(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.a = lin
+            self.b = lin
+
+        def forward(self, x):
+            return self.b(self.a(x))
+
+    model = Tied()
+    params = model.init(jax.random.key(0))
+    assert list(params) == ["a"]  # one shared parameter set
+    out = model.apply(params, jnp.ones((1, 4)))
+    assert out.shape == (1, 4)
+
+
+def test_sequential_is_iterable():
+    seq = nn.Sequential(nn.ReLU(), nn.ReLU())
+    assert len(list(iter(seq))) == 2
+    with pytest.raises(IndexError):
+        seq[5]

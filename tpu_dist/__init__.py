@@ -1,0 +1,16 @@
+"""tpu_dist — a TPU-native distributed training framework.
+
+Provides the machinery the reference tutorial
+(Jackxiini/Pytorch-distributed-learning) obtains from PyTorch, redesigned for
+TPU.  Currently shipped subpackages:
+
+- ``tpu_dist.nn`` — functional module system + XLA-lowered layers/losses
+- ``tpu_dist.optim`` — pure-pytree optimizers (SGD w/ momentum/nesterov/wd)
+- ``tpu_dist.models`` — reference workloads (MNIST ConvNet, ResNet-18/34/50)
+"""
+
+__version__ = "0.1.0"
+
+from . import models, nn, optim
+
+__all__ = ["nn", "optim", "models", "__version__"]

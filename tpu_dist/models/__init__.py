@@ -1,0 +1,6 @@
+"""tpu_dist.models — reference workload architectures."""
+
+from .convnet import ConvNet
+from .resnet import ResNet, resnet18, resnet34, resnet50
+
+__all__ = ["ConvNet", "ResNet", "resnet18", "resnet34", "resnet50"]

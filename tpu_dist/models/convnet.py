@@ -1,0 +1,43 @@
+"""MNIST ConvNet — exact architecture of the reference tutorial.
+
+Mirrors ``ConvNet`` at /root/reference/mpspawn_dist.py:11-43 (duplicated at
+/root/reference/launch_dist.py:9-41) layer by layer, including its quirks:
+
+- conv1: 5x5, stride 1, padding **1** (not 2) → 28x28 → 26x26
+- maxpool1: 2x2 stride 2 → 13x13
+- conv2: 3x3, no padding → 11x11; maxpool2: 2x2 **stride 1** → 10x10
+- conv3: 3x3, no padding → 8x8; maxpool3: 2x2 stride 2 → 4x4
+- fc: 128*4*4 → 10
+- a Dropout(0.5) layer is *defined but never used in forward* (dead layer in
+  the reference; reproduced for parameter/architecture parity).
+
+Input layout is NHWC (TPU-first): (batch, 28, 28, 1).
+"""
+
+from __future__ import annotations
+
+from .. import nn
+
+__all__ = ["ConvNet"]
+
+
+class ConvNet(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.relu = nn.ReLU()
+        self.conv1 = nn.Conv2d(1, 32, kernel_size=5, stride=1, padding=1)
+        self.maxpool1 = nn.MaxPool2d(kernel_size=2, stride=2)
+        self.conv2 = nn.Conv2d(32, 64, kernel_size=3, stride=1)
+        self.maxpool2 = nn.MaxPool2d(kernel_size=2, stride=1)
+        self.conv3 = nn.Conv2d(64, 128, kernel_size=3, stride=1)
+        self.maxpool3 = nn.MaxPool2d(kernel_size=2, stride=2)
+        self.dropout = nn.Dropout(p=0.5)  # defined, never called (as in ref)
+        self.fc1 = nn.Linear(128 * 4 * 4, 10)
+
+    def forward(self, x):
+        x = self.maxpool1(self.relu(self.conv1(x)))
+        x = self.maxpool2(self.relu(self.conv2(x)))
+        x = self.maxpool3(self.relu(self.conv3(x)))
+        x = x.reshape(x.shape[0], -1)
+        x = self.fc1(x)
+        return x

@@ -1,0 +1,160 @@
+"""ResNet family — torchvision-architecture parity, TPU-native implementation.
+
+The reference instantiates ``torchvision.models.resnet18(pretrained=False,
+num_classes=10)`` (/root/reference/example_mp.py:50,
+/root/reference/example_launch.py:26) and trains it on 32x32 CIFAR-10 with the
+*ImageNet* stem (7x7 stride-2 conv + 3x3 stride-2 maxpool).  We reproduce that
+architecture exactly (BasicBlock [2,2,2,2]) so parameter counts and shapes
+match, plus ResNet-50 (Bottleneck [3,4,6,3]) for the scaling ladder
+(BASELINE.md config #5).
+
+Initialization follows torchvision: kaiming_normal(fan_out, relu) for convs,
+BN weight=1/bias=0, default Linear init for the classifier head.  BatchNorm is
+per-replica by default (DDP semantics — DDP does not sync BN stats); pass
+``bn_axis_name='data'`` for cross-replica SyncBN.
+
+Layout NHWC; input (batch, H, W, 3).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Type, Union
+
+import jax
+
+from .. import nn
+from ..nn import init as init_lib
+
+__all__ = ["ResNet", "BasicBlock", "Bottleneck", "resnet18", "resnet34",
+           "resnet50"]
+
+
+class _KaimingConv2d(nn.Conv2d):
+    """Conv2d with torchvision ResNet init (kaiming_normal fan_out, relu)."""
+
+    def create_params(self, key):
+        kh, kw = self.kernel_size
+        shape = (kh, kw, self.in_channels // self.groups, self.out_channels)
+        p = {"weight": init_lib.kaiming_normal(key, shape, mode="fan_out",
+                                               nonlinearity="relu")}
+        if self.use_bias:
+            p["bias"] = init_lib.zeros((self.out_channels,))
+        return p
+
+
+def conv3x3(in_ch: int, out_ch: int, stride: int = 1) -> nn.Conv2d:
+    return _KaimingConv2d(in_ch, out_ch, kernel_size=3, stride=stride,
+                          padding=1, bias=False)
+
+
+def conv1x1(in_ch: int, out_ch: int, stride: int = 1) -> nn.Conv2d:
+    return _KaimingConv2d(in_ch, out_ch, kernel_size=1, stride=stride,
+                          bias=False)
+
+
+class BasicBlock(nn.Module):
+    expansion = 1
+
+    def __init__(self, in_ch: int, planes: int, stride: int = 1,
+                 downsample: Optional[nn.Module] = None,
+                 bn_axis_name: Optional[str] = None):
+        super().__init__()
+        bn = lambda c: nn.BatchNorm2d(c, axis_name=bn_axis_name)
+        self.conv1 = conv3x3(in_ch, planes, stride)
+        self.bn1 = bn(planes)
+        self.relu = nn.ReLU()
+        self.conv2 = conv3x3(planes, planes)
+        self.bn2 = bn(planes)
+        self.downsample = downsample if downsample is not None else nn.Identity()
+        self.has_downsample = downsample is not None
+
+    def forward(self, x):
+        identity = self.downsample(x) if self.has_downsample else x
+        out = self.relu(self.bn1(self.conv1(x)))
+        out = self.bn2(self.conv2(out))
+        return self.relu(out + identity)
+
+
+class Bottleneck(nn.Module):
+    expansion = 4
+
+    def __init__(self, in_ch: int, planes: int, stride: int = 1,
+                 downsample: Optional[nn.Module] = None,
+                 bn_axis_name: Optional[str] = None):
+        super().__init__()
+        bn = lambda c: nn.BatchNorm2d(c, axis_name=bn_axis_name)
+        self.conv1 = conv1x1(in_ch, planes)
+        self.bn1 = bn(planes)
+        self.conv2 = conv3x3(planes, planes, stride)
+        self.bn2 = bn(planes)
+        self.conv3 = conv1x1(planes, planes * self.expansion)
+        self.bn3 = bn(planes * self.expansion)
+        self.relu = nn.ReLU()
+        self.downsample = downsample if downsample is not None else nn.Identity()
+        self.has_downsample = downsample is not None
+
+    def forward(self, x):
+        identity = self.downsample(x) if self.has_downsample else x
+        out = self.relu(self.bn1(self.conv1(x)))
+        out = self.relu(self.bn2(self.conv2(out)))
+        out = self.bn3(self.conv3(out))
+        return self.relu(out + identity)
+
+
+class ResNet(nn.Module):
+    def __init__(self, block: Type[Union[BasicBlock, Bottleneck]],
+                 layers: List[int], num_classes: int = 1000,
+                 bn_axis_name: Optional[str] = None):
+        super().__init__()
+        self.bn_axis_name = bn_axis_name
+        self.inplanes = 64
+        self.conv1 = _KaimingConv2d(3, 64, kernel_size=7, stride=2, padding=3,
+                                    bias=False)
+        self.bn1 = nn.BatchNorm2d(64, axis_name=bn_axis_name)
+        self.relu = nn.ReLU()
+        self.maxpool = nn.MaxPool2d(kernel_size=3, stride=2, padding=1)
+        self.layer1 = self._make_layer(block, 64, layers[0])
+        self.layer2 = self._make_layer(block, 128, layers[1], stride=2)
+        self.layer3 = self._make_layer(block, 256, layers[2], stride=2)
+        self.layer4 = self._make_layer(block, 512, layers[3], stride=2)
+        self.avgpool = nn.AdaptiveAvgPool2d(1)
+        self.fc = nn.Linear(512 * block.expansion, num_classes)
+
+    def _make_layer(self, block, planes: int, blocks: int,
+                    stride: int = 1) -> nn.Sequential:
+        downsample = None
+        if stride != 1 or self.inplanes != planes * block.expansion:
+            downsample = nn.Sequential(
+                conv1x1(self.inplanes, planes * block.expansion, stride),
+                nn.BatchNorm2d(planes * block.expansion,
+                               axis_name=self.bn_axis_name),
+            )
+        blocks_list = [block(self.inplanes, planes, stride, downsample,
+                             self.bn_axis_name)]
+        self.inplanes = planes * block.expansion
+        for _ in range(1, blocks):
+            blocks_list.append(block(self.inplanes, planes,
+                                     bn_axis_name=self.bn_axis_name))
+        return nn.Sequential(*blocks_list)
+
+    def forward(self, x):
+        x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+        x = self.layer1(x)
+        x = self.layer2(x)
+        x = self.layer3(x)
+        x = self.layer4(x)
+        x = self.avgpool(x)
+        x = x.reshape(x.shape[0], -1)
+        return self.fc(x)
+
+
+def resnet18(num_classes: int = 1000, **kw) -> ResNet:
+    return ResNet(BasicBlock, [2, 2, 2, 2], num_classes, **kw)
+
+
+def resnet34(num_classes: int = 1000, **kw) -> ResNet:
+    return ResNet(BasicBlock, [3, 4, 6, 3], num_classes, **kw)
+
+
+def resnet50(num_classes: int = 1000, **kw) -> ResNet:
+    return ResNet(Bottleneck, [3, 4, 6, 3], num_classes, **kw)

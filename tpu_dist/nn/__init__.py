@@ -1,0 +1,15 @@
+"""tpu_dist.nn — functional module system + layers (L2 of the layer map,
+SURVEY.md §1)."""
+
+from . import functional, init
+from .layers import (AdaptiveAvgPool2d, AvgPool2d, BatchNorm2d, Conv2d,
+                     Dropout, Flatten, Identity, Linear, MaxPool2d, ReLU)
+from .loss import CrossEntropyLoss
+from .module import Module, Sequential
+
+__all__ = [
+    "Module", "Sequential", "functional", "init",
+    "Linear", "Conv2d", "MaxPool2d", "AvgPool2d", "AdaptiveAvgPool2d",
+    "ReLU", "Flatten", "Dropout", "BatchNorm2d", "Identity",
+    "CrossEntropyLoss",
+]

@@ -1,0 +1,82 @@
+"""Parameter initializers with torch-compatible semantics.
+
+The reference relies on torch's default layer initialization (ConvNet at
+/root/reference/mpspawn_dist.py:11-43 never overrides init; torchvision
+ResNet-18 at /root/reference/example_mp.py:50 uses kaiming_normal fan_out for
+convs).  Matching the *distributions* (not the RNG streams) keeps training
+dynamics comparable for loss-parity testing.
+
+Weight layouts are TPU-first: conv kernels are HWIO, linear weights are
+``(in_features, out_features)``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "calculate_fan", "uniform", "normal", "zeros", "ones",
+    "kaiming_uniform", "kaiming_normal", "torch_default_uniform",
+]
+
+
+def calculate_fan(shape: Sequence[int]) -> Tuple[int, int]:
+    """Return ``(fan_in, fan_out)`` for HWIO conv or (in, out) linear shapes."""
+    if len(shape) == 2:  # linear: (in, out)
+        return shape[0], shape[1]
+    if len(shape) == 4:  # conv HWIO: (kh, kw, in, out)
+        receptive = shape[0] * shape[1]
+        return receptive * shape[2], receptive * shape[3]
+    raise ValueError(f"Unsupported weight shape {shape}")
+
+
+def uniform(key, shape, minval, maxval, dtype=jnp.float32):
+    return jax.random.uniform(key, shape, dtype, minval, maxval)
+
+
+def normal(key, shape, std, dtype=jnp.float32):
+    return jax.random.normal(key, shape, dtype) * std
+
+
+def zeros(shape, dtype=jnp.float32):
+    return jnp.zeros(shape, dtype)
+
+
+def ones(shape, dtype=jnp.float32):
+    return jnp.ones(shape, dtype)
+
+
+def _gain(nonlinearity: str, a: float) -> float:
+    if nonlinearity == "relu":
+        return math.sqrt(2.0)
+    if nonlinearity == "leaky_relu":
+        return math.sqrt(2.0 / (1 + a * a))
+    if nonlinearity == "linear":
+        return 1.0
+    raise ValueError(f"Unsupported nonlinearity {nonlinearity!r}")
+
+
+def kaiming_uniform(key, shape, a: float = 0.0, mode: str = "fan_in",
+                    nonlinearity: str = "leaky_relu", dtype=jnp.float32):
+    fan_in, fan_out = calculate_fan(shape)
+    fan = fan_in if mode == "fan_in" else fan_out
+    bound = _gain(nonlinearity, a) * math.sqrt(3.0 / fan)
+    return uniform(key, shape, -bound, bound, dtype)
+
+
+def kaiming_normal(key, shape, a: float = 0.0, mode: str = "fan_in",
+                   nonlinearity: str = "leaky_relu", dtype=jnp.float32):
+    fan_in, fan_out = calculate_fan(shape)
+    fan = fan_in if mode == "fan_in" else fan_out
+    std = _gain(nonlinearity, a) / math.sqrt(fan)
+    return normal(key, shape, std, dtype)
+
+
+def torch_default_uniform(key, shape, fan_in: int, dtype=jnp.float32):
+    """torch's default Conv/Linear weight+bias init: U(-1/sqrt(fan_in), +)."""
+    bound = 1.0 / math.sqrt(fan_in) if fan_in > 0 else 0.0
+    return uniform(key, shape, -bound, bound, dtype)

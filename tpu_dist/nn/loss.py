@@ -1,0 +1,24 @@
+"""Loss modules (torch ``nn.CrossEntropyLoss`` parity —
+/root/reference/mpspawn_dist.py:63, /root/reference/example_mp.py:83)."""
+
+from __future__ import annotations
+
+from . import functional as F
+from .module import Module
+
+__all__ = ["CrossEntropyLoss"]
+
+
+class CrossEntropyLoss(Module):
+    """Softmax cross-entropy over integer class labels."""
+
+    def __init__(self, reduction: str = "mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, logits, labels):
+        return F.cross_entropy(logits, labels, self.reduction)
+
+    # Losses carry no parameters, so allow calling outside apply() too.
+    def __call__(self, logits, labels):
+        return self.forward(logits, labels)

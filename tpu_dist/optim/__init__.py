@@ -1,0 +1,5 @@
+"""tpu_dist.optim — pure-pytree optimizers."""
+
+from .sgd import SGD
+
+__all__ = ["SGD"]
