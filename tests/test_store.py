@@ -1,0 +1,189 @@
+"""TCPStore / FileStore — the native rendezvous store (c10d TCPStore parity,
+SURVEY.md §2 #8).  Exercises both the C++ server (csrc/tcpstore.cpp via
+ctypes) and the pure-Python fallback speaking the same wire protocol."""
+
+import struct
+import threading
+import time
+
+import pytest
+
+from tpu_dist.dist.store import (FileStore, PyTCPStoreServer, TCPStore,
+                                 _PyClient, _load_native)
+
+
+@pytest.fixture(params=["native", "python"])
+def store(request, monkeypatch, tmp_path):
+    if request.param == "native" and _load_native() is None:
+        pytest.skip("native toolchain unavailable")
+    if request.param == "python":
+        monkeypatch.setenv("TPU_DIST_PURE_PYTHON_STORE", "1")
+        import tpu_dist.dist.store as S
+        monkeypatch.setattr(S, "_native_tried", False)
+        monkeypatch.setattr(S, "_native_lib", None)
+    s = TCPStore(is_master=True)
+    yield s
+    s.close()
+
+
+class TestStoreOps:
+    def test_set_get(self, store):
+        store.set("alpha", b"hello")
+        assert store.get("alpha") == b"hello"
+
+    def test_set_str_coerced(self, store):
+        store.set("k", "text")
+        assert store.get("k") == b"text"
+
+    def test_get_blocks_until_set(self, store):
+        result = {}
+
+        def getter():
+            result["v"] = store2.get("late-key")
+
+        store2 = TCPStore(host=store.host, port=store.port)
+        t = threading.Thread(target=getter)
+        t.start()
+        time.sleep(0.1)
+        assert t.is_alive()  # still blocked
+        store.set("late-key", b"now")
+        t.join(timeout=5)
+        assert result["v"] == b"now"
+        store2.close()
+
+    def test_add_and_counter(self, store):
+        assert store.add("ctr", 5) == 5
+        assert store.add("ctr", 3) == 8
+        assert store.add("ctr", -2) == 6
+        assert store.add("ctr", 0) == 6
+
+    def test_check_delete_numkeys(self, store):
+        assert not store.check("x")
+        store.set("x", b"1")
+        assert store.check("x")
+        n0 = store.num_keys()
+        assert store.delete_key("x")
+        assert not store.delete_key("x")
+        assert store.num_keys() == n0 - 1
+
+    def test_wait(self, store):
+        store.set("a", b"1")
+        store.wait(["a"], timeout=1)
+        with pytest.raises(TimeoutError):
+            store.wait(["never"], timeout=0.2)
+
+    def test_barrier_two_clients(self, store):
+        c2 = TCPStore(host=store.host, port=store.port)
+        errs = []
+
+        def member(s):
+            try:
+                s.barrier(world_size=2, tag="t0", timeout=5)
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        t1 = threading.Thread(target=member, args=(store,))
+        t2 = threading.Thread(target=member, args=(c2,))
+        t1.start()
+        time.sleep(0.05)
+        t2.start()
+        t1.join(5)
+        t2.join(5)
+        assert not errs and not t1.is_alive() and not t2.is_alive()
+        c2.close()
+
+    def test_barrier_reusable_same_tag(self, store):
+        c2 = TCPStore(host=store.host, port=store.port)
+        errs = []
+
+        def member(s):
+            try:
+                for _ in range(3):  # same tag every round
+                    s.barrier(world_size=2, tag="loop", timeout=5)
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        t1 = threading.Thread(target=member, args=(store,))
+        t2 = threading.Thread(target=member, args=(c2,))
+        t1.start()
+        t2.start()
+        t1.join(10)
+        t2.join(10)
+        assert not errs and not t1.is_alive() and not t2.is_alive()
+        c2.close()
+
+    def test_wait_value_ge_blocking(self, store):
+        done = threading.Event()
+
+        def waiter():
+            store2.wait_value_ge("cnt", 3)
+            done.set()
+
+        store2 = TCPStore(host=store.host, port=store.port)
+        t = threading.Thread(target=waiter)
+        t.start()
+        store.add("cnt", 1)
+        time.sleep(0.05)
+        assert not done.is_set()
+        store.add("cnt", 2)
+        t.join(5)
+        assert done.is_set()
+        store2.close()
+
+    def test_binary_values(self, store):
+        payload = bytes(range(256)) * 4
+        store.set("bin", payload)
+        assert store.get("bin") == payload
+
+
+class TestInterop:
+    """Python client against C++ server — one protocol, two implementations."""
+
+    def test_py_client_native_server(self):
+        if _load_native() is None:
+            pytest.skip("native toolchain unavailable")
+        server = TCPStore(is_master=True)
+        assert server.native
+        py = _PyClient("127.0.0.1", server.port, timeout=5)
+        py.request(1, "k", b"v")  # SET
+        assert py.request(2, "k") == b"v"  # GET
+        out = py.request(3, "n", struct.pack("<q", 7))  # ADD
+        assert struct.unpack("<q", out)[0] == 7
+        py.close()
+        server.close()
+
+    def test_native_falls_back_cleanly(self, monkeypatch):
+        monkeypatch.setenv("TPU_DIST_PURE_PYTHON_STORE", "1")
+        import tpu_dist.dist.store as S
+        monkeypatch.setattr(S, "_native_tried", False)
+        monkeypatch.setattr(S, "_native_lib", None)
+        s = TCPStore(is_master=True)
+        assert not s.native
+        assert isinstance(s._server, PyTCPStoreServer)
+        s.set("a", b"b")
+        assert s.get("a") == b"b"
+        s.close()
+
+
+class TestFileStore:
+    def test_roundtrip(self, tmp_path):
+        s = FileStore(str(tmp_path / "store"))
+        s.set("k/with/slash", b"v")
+        assert s.get("k/with/slash") == b"v"
+        assert s.check("k/with/slash")
+        assert s.add("c", 2) == 2
+        assert s.add("c", 2) == 4
+        assert s.num_keys() == 2
+        assert s.delete_key("c")
+        assert s.num_keys() == 1
+
+    def test_concurrent_add(self, tmp_path):
+        s = FileStore(str(tmp_path / "store"))
+        threads = [threading.Thread(target=lambda: [s.add("n", 1)
+                                                    for _ in range(20)])
+                   for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert s.add("n", 0) == 80

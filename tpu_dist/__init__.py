@@ -7,10 +7,12 @@ TPU.  Currently shipped subpackages:
 - ``tpu_dist.nn`` — functional module system + XLA-lowered layers/losses
 - ``tpu_dist.optim`` — pure-pytree optimizers (SGD w/ momentum/nesterov/wd)
 - ``tpu_dist.models`` — reference workloads (MNIST ConvNet, ResNet-18/34/50)
+- ``tpu_dist.dist`` — process groups, rendezvous, TCP/File stores (c10d)
+- ``tpu_dist.collectives`` — in-jit (psum/ring) + eager collectives
 """
 
 __version__ = "0.1.0"
 
-from . import models, nn, optim
+from . import collectives, dist, models, nn, optim
 
-__all__ = ["nn", "optim", "models", "__version__"]
+__all__ = ["nn", "optim", "models", "dist", "collectives", "__version__"]

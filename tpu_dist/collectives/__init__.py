@@ -1,0 +1,30 @@
+"""tpu_dist.collectives — L0/L1 collective communication.
+
+Two API surfaces, reflecting how TPU differs from the reference's NCCL world
+(ring-allreduce described at /root/reference/README.md:5-20, invoked
+implicitly by DDP in every ``loss.backward()``):
+
+- **In-jit** (:mod:`.ops`): functions used *inside* ``shard_map``/``pjit``
+  over a mesh axis — ``all_reduce``→``lax.psum`` etc.  XLA fuses these into
+  the surrounding graph and lowers them to ICI collectives; this is where the
+  gradient all-reduce of the DDP wrapper lives.
+- **Eager** (:mod:`.eager`): host-level collectives on a
+  :class:`~tpu_dist.dist.ProcessGroup` for occasional out-of-graph syncs
+  (metric averaging, parameter broadcast at init) — the closest analogue of
+  torch's ``dist.all_reduce(tensor)`` call style.
+
+:func:`ops.ring_all_reduce` is a ppermute-based reduce-scatter + all-gather
+ring — the literal algorithm the reference README teaches, runnable on the
+TPU torus; numerically equal to ``psum`` (tested) but kept for teaching and
+as a building block for later pipeline/sequence parallelism.
+"""
+
+from .ops import (all_gather, all_reduce, all_to_all, broadcast, pmean,
+                  ppermute, psum, reduce_scatter, ring_all_reduce)
+from .eager import (all_gather_host, all_reduce_host, broadcast_host)
+
+__all__ = [
+    "all_reduce", "all_gather", "reduce_scatter", "broadcast", "all_to_all",
+    "ppermute", "psum", "pmean", "ring_all_reduce",
+    "all_reduce_host", "all_gather_host", "broadcast_host",
+]

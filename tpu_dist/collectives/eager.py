@@ -1,0 +1,72 @@
+"""Eager host-level collectives on a ProcessGroup.
+
+torch call-style parity (``dist.all_reduce(tensor)``,
+/root/reference/README.md:38-43 usage flow) for out-of-graph syncs: metric
+averaging, init-time parameter broadcast, debugging.  NOT for the training
+hot path — there the all-reduce is fused into the jitted step
+(tpu_dist.parallel); each eager call is a separate compiled program.
+
+Semantics: the input is this *process*'s local value; the collective runs
+across all processes of the group (one leader device per process carries the
+payload).  Single-process groups are a fast no-op/copy, so the same training
+script runs unchanged from 1 host to a pod (the property the reference gets
+from torch.distributed working at world_size=1).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["all_reduce_host", "all_gather_host", "broadcast_host"]
+
+
+def _default_group(group):
+    if group is None:
+        from ..dist import get_default_group
+        group = get_default_group()
+    return group
+
+
+def all_reduce_host(x, group=None, op: str = "sum"):
+    """Reduce a per-process host value across processes; returns the reduced
+    value on host (as numpy / python scalar tree)."""
+    group = _default_group(group)
+    np_op = {"sum": None, "avg": None, "mean": None, "max": np.maximum,
+             "min": np.minimum}
+    if op.lower() not in np_op:
+        raise ValueError(f"Unknown reduce op {op!r}")
+    if group.num_processes <= 1:
+        return jax.tree.map(np.asarray, x)
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(x)  # leading axis = process
+    if op.lower() == "sum":
+        return jax.tree.map(lambda v: np.sum(v, axis=0), gathered)
+    if op.lower() in ("avg", "mean"):
+        return jax.tree.map(lambda v: np.mean(v, axis=0), gathered)
+    fn = np_op[op.lower()]
+    return jax.tree.map(lambda v: fn.reduce(v, axis=0), gathered)
+
+
+def all_gather_host(x, group=None):
+    """Gather per-process values; returns tree with leading process axis."""
+    group = _default_group(group)
+    if group.num_processes <= 1:
+        return jax.tree.map(lambda v: np.asarray(v)[None], x)
+    from jax.experimental import multihost_utils
+    return multihost_utils.process_allgather(x)
+
+
+def broadcast_host(x, group=None, src: int = 0):
+    """Broadcast process ``src``'s value to all processes (DDP's wrap-time
+    rank-0 parameter broadcast, /root/reference/example_mp.py:53)."""
+    group = _default_group(group)
+    if group.num_processes <= 1:
+        return jax.tree.map(np.asarray, x)
+    from jax.experimental import multihost_utils
+    return multihost_utils.broadcast_one_to_all(
+        x, is_source=group.rank == src)

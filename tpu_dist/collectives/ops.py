@@ -1,0 +1,169 @@
+"""In-jit collectives over mesh axes (the NCCL kernel equivalents).
+
+These are meant to be called inside ``jax.shard_map`` / ``pmap`` bodies with
+the mesh axis name; XLA lowers them to ICI/DCN collectives and fuses them
+with surrounding compute — the property the reference gets from NCCL+DDP
+overlap (/root/reference/README.md:9-20) falls out of compilation here.
+
+Reduction ops mirror torch.distributed.ReduceOp: SUM, AVG (mean), MAX, MIN,
+PRODUCT.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["all_reduce", "psum", "pmean", "all_gather", "reduce_scatter",
+           "broadcast", "all_to_all", "ppermute", "ring_all_reduce"]
+
+_REDUCE_OPS = {
+    "sum": lax.psum,
+    "avg": lax.pmean,
+    "mean": lax.pmean,
+    "max": lax.pmax,
+    "min": lax.pmin,
+}
+
+
+def all_reduce(x, axis_name: str, op: str = "sum"):
+    """All-reduce over a mesh axis (ReduceOp parity).
+
+    ``op='product'`` has no direct lax primitive; computed as
+    ``exp(psum(log))`` would lose sign, so it is lowered via all_gather+prod.
+    """
+    op = op.lower()
+    if op in _REDUCE_OPS:
+        return jax.tree.map(lambda v: _REDUCE_OPS[op](v, axis_name), x)
+    if op in ("prod", "product"):
+        return jax.tree.map(
+            lambda v: jnp.prod(lax.all_gather(v, axis_name, axis=0), axis=0), x)
+    raise ValueError(f"Unknown reduce op {op!r}")
+
+
+def psum(x, axis_name: str):
+    return jax.tree.map(lambda v: lax.psum(v, axis_name), x)
+
+
+def pmean(x, axis_name: str):
+    return jax.tree.map(lambda v: lax.pmean(v, axis_name), x)
+
+
+def all_gather(x, axis_name: str, axis: int = 0, tiled: bool = False):
+    """Gather shards from every device along ``axis``.
+
+    ``tiled=False`` stacks (new leading dim of size world); ``tiled=True``
+    concatenates along ``axis`` (torch ``all_gather_into_tensor`` style).
+    """
+    return jax.tree.map(
+        lambda v: lax.all_gather(v, axis_name, axis=axis, tiled=tiled), x)
+
+
+def reduce_scatter(x, axis_name: str, scatter_axis: int = 0, op: str = "sum"):
+    """Reduce across the axis, leaving each device its 1/world slice —
+    ``lax.psum_scatter``; the building block of ring all-reduce."""
+    if op.lower() not in ("sum", "avg", "mean"):
+        raise ValueError("reduce_scatter supports sum/avg")
+    out = jax.tree.map(
+        lambda v: lax.psum_scatter(v, axis_name, scatter_dimension=scatter_axis,
+                                   tiled=True), x)
+    if op.lower() in ("avg", "mean"):
+        n = lax.psum(1, axis_name)
+        out = jax.tree.map(lambda v: v / n, out)
+    return out
+
+
+def broadcast(x, axis_name: str, src: int = 0):
+    """Broadcast ``src``'s value to every device on the axis.
+
+    DDP does this once at wrap time to align parameters
+    (rank-0 broadcast; the reference relies on it at
+    /root/reference/example_mp.py:53 in lieu of seeding).  Implemented as
+    mask+psum, which XLA lowers to an efficient one-to-all.
+    """
+    idx = lax.axis_index(axis_name)
+
+    def _bcast(v):
+        vv = jnp.asarray(v)
+        return lax.psum(jnp.where(idx == src, vv, jnp.zeros_like(vv)),
+                        axis_name)
+
+    return jax.tree.map(_bcast, x)
+
+
+def all_to_all(x, axis_name: str, split_axis: int, concat_axis: int):
+    """All-to-all (the Ulysses sequence-parallel primitive); each device
+    splits along ``split_axis`` and concatenates received chunks along
+    ``concat_axis``."""
+    return jax.tree.map(
+        lambda v: lax.all_to_all(v, axis_name, split_axis=split_axis,
+                                 concat_axis=concat_axis, tiled=True), x)
+
+
+def ppermute(x, axis_name: str, perm: Sequence[Tuple[int, int]]):
+    """Point-to-point permutation over the axis (ring hops)."""
+    return jax.tree.map(lambda v: lax.ppermute(v, axis_name, perm=perm), x)
+
+
+def ring_all_reduce(x, axis_name: str, axis_size: Optional[int] = None):
+    """Ring all-reduce, spelled out: the algorithm the reference README
+    teaches (/root/reference/README.md:9-20) — N-1 reduce-scatter hops then
+    N-1 all-gather hops around a ring, per-step volume constant in world
+    size.
+
+    On TPU the ring is physical (ICI torus links between neighbours), so the
+    ppermute hops below map 1:1 onto hardware — but note ``lax.psum`` already
+    compiles to this (or better); this explicit version exists for teaching
+    parity and as the pattern for ring attention.  Numerically equal to
+    ``psum`` (tested in tests/test_collectives.py).
+
+    Requires each leaf's leading dimension divisible by the axis size.
+    """
+    n = axis_size if axis_size is not None else lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    ring_fwd = [(i, (i + 1) % n) for i in range(n)]
+
+    def _ring(v):
+        if v.shape[0] % n:
+            raise ValueError(
+                f"ring_all_reduce needs leading dim divisible by axis size "
+                f"{n}; got shape {v.shape}. Pad or use psum.")
+        me = lax.axis_index(axis_name)
+        chunks = v.reshape((n, v.shape[0] // n) + v.shape[1:])
+
+        # Phase 1 — reduce-scatter: after N-1 hops, device d holds the full
+        # sum of chunk (d+1) mod n.
+        def rs_step(i, acc):
+            # acc: the partial chunk being accumulated, travelling the ring
+            acc = lax.ppermute(acc, axis_name, perm=ring_fwd)
+            recv_idx = jnp.mod(me - i - 1, n)
+            return acc + lax.dynamic_index_in_dim(chunks, recv_idx, 0,
+                                                  keepdims=False)
+
+        start = lax.dynamic_index_in_dim(chunks, jnp.mod(me, n), 0,
+                                         keepdims=False)
+        acc = lax.fori_loop(0, n - 1, rs_step, start)
+        # device d now owns the reduced chunk with index (d - (n-1)) mod n
+        # = (d+1) mod n.
+
+        # Phase 2 — all-gather: circulate reduced chunks N-1 hops; each
+        # device scatters what it receives into its output buffer.
+        own_idx = jnp.mod(me + 1, n)
+        out = jnp.zeros_like(chunks)
+        out = lax.dynamic_update_index_in_dim(out, acc, own_idx, 0)
+
+        def ag_step(i, carry):
+            out, piece = carry
+            piece = lax.ppermute(piece, axis_name, perm=ring_fwd)
+            idx = jnp.mod(me - i, n)  # index of the chunk just received
+            out = lax.dynamic_update_index_in_dim(out, piece, idx, 0)
+            return out, piece
+
+        out, _ = lax.fori_loop(0, n - 1, ag_step, (out, acc))
+        return out.reshape(v.shape)
+
+    return jax.tree.map(_ring, x)

@@ -1,0 +1,68 @@
+"""Lazy build of the native (C++) components.
+
+Compiles ``csrc/*.cpp`` into ``libtpudist.so`` with g++ on first use and
+caches by source mtime.  No pybind11 in this environment — the library
+exposes a plain C ABI consumed via ctypes (tpu_dist/dist/store.py).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SOURCES = [os.path.join(_DIR, "tcpstore.cpp")]
+_LIB = os.path.join(_DIR, "libtpudist.so")
+_lock = threading.Lock()
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def lib_path() -> str:
+    return _LIB
+
+
+def _stale() -> bool:
+    if not os.path.exists(_LIB):
+        return True
+    lib_mtime = os.path.getmtime(_LIB)
+    return any(os.path.getmtime(s) > lib_mtime for s in _SOURCES)
+
+
+def ensure_built(quiet: bool = True) -> str:
+    """Compile if missing/stale; returns the .so path."""
+    with _lock:
+        if not _stale():
+            return _LIB
+        # Cross-process safety (N ranks importing simultaneously): hold an
+        # fcntl lock for the compile, emit to a per-pid temp file, and
+        # os.replace() it into place so no process ever dlopens a
+        # half-written library.
+        import fcntl
+        lockfile = _LIB + ".lock"
+        with open(lockfile, "w") as lf:
+            fcntl.flock(lf, fcntl.LOCK_EX)
+            try:
+                if not _stale():  # another process built it while we waited
+                    return _LIB
+                tmp = f"{_LIB}.{os.getpid()}.tmp"
+                cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                       "-pthread", "-o", tmp] + _SOURCES
+                try:
+                    proc = subprocess.run(cmd, capture_output=True, text=True,
+                                          timeout=120)
+                except (OSError, subprocess.TimeoutExpired) as e:
+                    raise NativeBuildError(
+                        f"native build failed to run: {e}") from e
+                if proc.returncode != 0:
+                    raise NativeBuildError(
+                        f"native build failed:\n{proc.stderr[-2000:]}")
+                os.replace(tmp, _LIB)
+            finally:
+                fcntl.flock(lf, fcntl.LOCK_UN)
+        if not quiet:
+            print(f"[tpu_dist] built native library {_LIB}")
+        return _LIB

@@ -1,0 +1,322 @@
+"""Process groups over TPU device meshes — the c10d equivalent (L1).
+
+The reference initializes torch.distributed process groups
+(``init_process_group('nccl', 'env://', world_size, rank)`` at
+/root/reference/mpspawn_dist.py:49-54, /root/reference/launch_dist.py:49,
+``tcp://`` at /root/reference/example_mp.py:37-42) where **one process drives
+one GPU**, so *rank*, *process* and *device* are the same thing.
+
+On TPU the natural topology is different and this module embraces it:
+
+- **one process per host** drives all local cores (SPMD);
+- a :class:`ProcessGroup` is a set of *devices* wrapped in a
+  :class:`jax.sharding.Mesh`; collectives ride the ICI torus between them;
+- cross-host coordination happens over DCN via JAX's coordination service
+  (the TCPStore/NCCL-bootstrap analogue).
+
+Terminology used throughout the framework:
+
+===================  ========================================================
+``world_size``       number of **devices** (cores) in the group — the DDP
+                     replica count (what the reference calls total GPUs,
+                     ``gpus × nodes``, /root/reference/mpspawn_dist.py:136)
+``rank``             this **process**'s rank (0..num_processes-1) — what the
+                     launcher env contract calls ``RANK``
+``num_processes``    host processes participating (= nnodes on TPU)
+``local_world_size`` devices addressable by this process
+===================  ========================================================
+
+Usage (single host, 8 cores — the ``mp.spawn`` scenario collapsed into one
+process)::
+
+    import tpu_dist.dist as dist
+    dist.init_process_group(backend="tpu")
+    dist.get_world_size()   # 8  (devices)
+    dist.get_rank()         # 0  (process)
+
+Multi-host (launched via ``python -m tpu_dist.launch`` or manually with the
+MASTER_ADDR/PORT env contract)::
+
+    dist.init_process_group(backend="tpu", init_method="env://")
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+from . import rendezvous as _rdzv
+
+__all__ = [
+    "ProcessGroup",
+    "init_process_group",
+    "destroy_process_group",
+    "is_initialized",
+    "get_default_group",
+    "get_world_size",
+    "get_rank",
+    "get_local_rank",
+    "get_local_world_size",
+    "get_num_processes",
+    "new_group",
+    "barrier",
+    "DATA_AXIS",
+]
+
+# Default mesh axis name for data parallelism; parallel/ and collectives/
+# assume this unless a group was built with custom axes.
+DATA_AXIS = "data"
+
+_state = threading.local()
+_DEFAULT_GROUP: Optional["ProcessGroup"] = None
+_lock = threading.Lock()
+
+
+class ProcessGroup:
+    """A set of devices + the mesh over them.
+
+    The torch analogue is the opaque ``ProcessGroup`` handle returned by
+    ``init_process_group``/``new_group`` (/root/reference/README.md:38-43);
+    here the handle *is* the mesh, and every collective or parallel wrapper
+    takes it (or defaults to the global group).
+    """
+
+    def __init__(self, devices: Sequence, axis_names: Sequence[str] = (DATA_AXIS,),
+                 mesh_shape: Optional[Sequence[int]] = None,
+                 parent: Optional["ProcessGroup"] = None):
+        import jax
+        from jax.sharding import Mesh
+
+        devices = tuple(devices)
+        if not devices:
+            raise ValueError("ProcessGroup needs at least one device")
+        if mesh_shape is None:
+            mesh_shape = (len(devices),)
+        if int(np.prod(mesh_shape)) != len(devices):
+            raise ValueError(
+                f"mesh_shape {tuple(mesh_shape)} does not cover {len(devices)} devices")
+        if len(axis_names) != len(mesh_shape):
+            raise ValueError("axis_names and mesh_shape must have equal length")
+        self._devices = devices
+        self._axis_names = tuple(axis_names)
+        self._mesh = Mesh(np.array(devices).reshape(tuple(mesh_shape)),
+                          self._axis_names)
+        self._parent = parent
+        self._process_index = jax.process_index()
+        self._num_processes = jax.process_count()
+        self._destroyed = False
+
+    # -- topology ------------------------------------------------------------
+    @property
+    def mesh(self):
+        """The :class:`jax.sharding.Mesh` over this group's devices."""
+        self._check_alive()
+        return self._mesh
+
+    @property
+    def devices(self):
+        return self._devices
+
+    @property
+    def axis_name(self) -> str:
+        """Primary (data) axis name."""
+        return self._axis_names[0]
+
+    @property
+    def axis_names(self):
+        return self._axis_names
+
+    def size(self) -> int:
+        """Device count — DDP replica count."""
+        return len(self._devices)
+
+    @property
+    def world_size(self) -> int:
+        return self.size()
+
+    @property
+    def rank(self) -> int:
+        """Process rank (the launcher-env ``RANK``)."""
+        return self._process_index
+
+    @property
+    def num_processes(self) -> int:
+        return self._num_processes
+
+    def local_devices(self):
+        """Devices of this group addressable by the current process."""
+        import jax
+        local = set(d.id for d in jax.local_devices())
+        return tuple(d for d in self._devices if d.id in local)
+
+    def local_device_ranks(self):
+        """Global (group-wise) ranks of this process's devices — what the
+        reference computes per worker as ``nr*gpus+gpu``
+        (/root/reference/mpspawn_dist.py:47)."""
+        import jax
+        local = set(d.id for d in jax.local_devices())
+        return tuple(i for i, d in enumerate(self._devices) if d.id in local)
+
+    @property
+    def local_world_size(self) -> int:
+        return len(self.local_devices())
+
+    # -- lifecycle -----------------------------------------------------------
+    def _check_alive(self):
+        if self._destroyed:
+            raise RuntimeError(
+                "ProcessGroup used after destroy_process_group()")
+
+    def destroy(self):
+        self._destroyed = True
+
+    def __repr__(self):
+        return (f"ProcessGroup(world_size={len(self._devices)}, "
+                f"rank={self._process_index}/{self._num_processes}, "
+                f"axes={dict(zip(self._axis_names, self._mesh.devices.shape))})")
+
+
+def init_process_group(backend: str = "tpu",
+                       init_method: Optional[str] = None,
+                       world_size: int = -1,
+                       rank: int = -1,
+                       timeout: Optional[float] = None,
+                       axis_names: Sequence[str] = (DATA_AXIS,),
+                       mesh_shape: Optional[Sequence[int]] = None) -> ProcessGroup:
+    """Bring up the default process group (c10d ``init_process_group`` parity).
+
+    ``backend``: ``'tpu'`` (XLA collectives over ICI/DCN — the NCCL
+    equivalent) or ``'cpu'`` (host-platform devices — the gloo equivalent;
+    requires JAX_PLATFORMS=cpu before first jax import).  The reference's
+    backend strings appear at /root/reference/README.md:133.
+
+    ``init_method``: ``None`` (single process), ``'env://'`` (read
+    MASTER_ADDR/MASTER_PORT/WORLD_SIZE/RANK — /root/reference/launch_dist.py:49),
+    or ``'tcp://host:port'`` (explicit coordinator —
+    /root/reference/example_mp.py:37-42).  ``world_size``/``rank`` here are
+    **process** counts, exactly the launcher env contract; they override env
+    values when given.
+
+    Blocks (like the NCCL rendezvous barrier) until all processes join,
+    then builds the group over every device in the slice.
+    """
+    global _DEFAULT_GROUP
+    with _lock:
+        if _DEFAULT_GROUP is not None and not _DEFAULT_GROUP._destroyed:
+            raise RuntimeError(
+                "Default process group already initialized; call "
+                "destroy_process_group() first.")
+
+        backend = backend.lower()
+        if backend in ("gloo",):
+            backend = "cpu"
+        if backend in ("nccl", "xla"):
+            backend = "tpu"
+        if backend not in ("tpu", "cpu"):
+            raise ValueError(f"Unknown backend {backend!r}; use 'tpu' or 'cpu'")
+
+        _rdzv.rendezvous(init_method, world_size=world_size, rank=rank,
+                         timeout=timeout)
+
+        import jax
+        devices = jax.devices()
+        group = ProcessGroup(devices, axis_names=axis_names,
+                             mesh_shape=mesh_shape)
+        _DEFAULT_GROUP = group
+        return group
+
+
+def is_initialized() -> bool:
+    return _DEFAULT_GROUP is not None and not _DEFAULT_GROUP._destroyed
+
+
+def get_default_group() -> ProcessGroup:
+    if not is_initialized():
+        raise RuntimeError(
+            "Default process group has not been initialized; call "
+            "tpu_dist.dist.init_process_group() first.")
+    return _DEFAULT_GROUP
+
+
+def _group(group: Optional[ProcessGroup]) -> ProcessGroup:
+    return group if group is not None else get_default_group()
+
+
+def get_world_size(group: Optional[ProcessGroup] = None) -> int:
+    """Device count of the group — the DDP replica count.
+
+    NOTE: on TPU this counts *cores*, not processes; the reference's
+    ``world_size = gpus × nodes`` (/root/reference/mpspawn_dist.py:136) counts
+    the same thing because there one process == one GPU.
+    """
+    return _group(group).size()
+
+
+def get_rank(group: Optional[ProcessGroup] = None) -> int:
+    """This process's rank (launcher ``RANK`` env)."""
+    return _group(group).rank
+
+
+def get_num_processes(group: Optional[ProcessGroup] = None) -> int:
+    return _group(group).num_processes
+
+
+def get_local_world_size(group: Optional[ProcessGroup] = None) -> int:
+    return _group(group).local_world_size
+
+
+def get_local_rank() -> int:
+    """Local rank from the launcher env (``LOCAL_RANK``,
+    /root/reference/launch_dist.py:46); 0 when not launched."""
+    return int(os.environ.get("LOCAL_RANK", 0))
+
+
+def new_group(ranks: Optional[Sequence[int]] = None,
+              axis_names: Sequence[str] = (DATA_AXIS,),
+              mesh_shape: Optional[Sequence[int]] = None) -> ProcessGroup:
+    """Sub-group over a subset of *device ranks* (c10d ``new_group``,
+    /root/reference/README.md:27-28,39).
+
+    Every process must call this collectively with identical ``ranks``.  The
+    sub-group's mesh spans only those devices; collectives over it ride the
+    sub-torus.
+    """
+    default = get_default_group()
+    if ranks is None:
+        ranks = range(default.size())
+    devices = [default.devices[r] for r in ranks]
+    return ProcessGroup(devices, axis_names=axis_names, mesh_shape=mesh_shape,
+                        parent=default)
+
+
+def barrier(group: Optional[ProcessGroup] = None) -> None:
+    """Block until all processes in the group reach the barrier.
+
+    Implemented as a tiny psum over one device per process (the TPU analogue
+    of a store-based barrier); a no-op single-process.
+    """
+    g = _group(group)
+    if g.num_processes <= 1:
+        return
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices("tpu_dist.barrier")
+
+
+def destroy_process_group(group: Optional[ProcessGroup] = None) -> None:
+    """Tear down the group (c10d parity, /root/reference/README.md:43).
+
+    Destroying the default group also shuts down the JAX distributed client
+    when one was started.
+    """
+    global _DEFAULT_GROUP
+    with _lock:
+        g = group if group is not None else _DEFAULT_GROUP
+        if g is None:
+            return
+        g.destroy()
+        if g is _DEFAULT_GROUP:
+            _DEFAULT_GROUP = None
+            _rdzv.shutdown()

@@ -1,0 +1,111 @@
+"""Rendezvous: how processes find each other (TCPStore/NCCL-bootstrap parity).
+
+The reference supports two styles:
+
+- ``env://`` — MASTER_ADDR/MASTER_PORT (+ WORLD_SIZE/RANK) env vars, set in
+  code (/root/reference/mpspawn_dist.py:137-138) or by the launcher
+  (/root/reference/README.md:341-343), consumed at
+  /root/reference/launch_dist.py:49;
+- ``tcp://host:port`` — explicit URL with world_size/rank kwargs
+  (/root/reference/example_mp.py:18,37-42).
+
+TPU-native both resolve to one thing: the address of JAX's coordination
+service (a gRPC server on process 0 — the TCPStore analogue), passed to
+``jax.distributed.initialize(coordinator, num_processes, process_id)``.
+After that call every process sees the whole slice via ``jax.devices()``
+and XLA collectives ride ICI/DCN directly — there is no NCCL-communicator
+bootstrap step because communicator construction is part of XLA compilation.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+from urllib.parse import urlparse
+
+__all__ = ["rendezvous", "shutdown", "parse_init_method"]
+
+_distributed_started = False
+
+
+def parse_init_method(init_method: Optional[str],
+                      world_size: int = -1,
+                      rank: int = -1) -> Tuple[Optional[str], int, int]:
+    """Resolve ``(coordinator_address, num_processes, process_id)``.
+
+    Returns ``(None, 1, 0)`` when the configuration is single-process (no
+    init_method and no multi-process env contract).
+    """
+    if init_method is None:
+        # Bare init_process_group(): single process unless the launcher's env
+        # contract says otherwise (torch treats this as env:// too).
+        if "MASTER_ADDR" in os.environ and "WORLD_SIZE" in os.environ:
+            init_method = "env://"
+        else:
+            return None, 1, 0
+
+    if init_method.startswith("env"):
+        addr = os.environ.get("MASTER_ADDR")
+        port = os.environ.get("MASTER_PORT")
+        if addr is None or port is None:
+            raise ValueError(
+                "init_method='env://' requires MASTER_ADDR and MASTER_PORT "
+                "env vars (set by tpu_dist.launch or by hand, as the "
+                "reference does at mpspawn_dist.py:137-138)")
+        if world_size < 0:
+            world_size = int(os.environ.get("WORLD_SIZE", 1))
+        if rank < 0:
+            rank = int(os.environ.get("RANK", 0))
+        return f"{addr}:{port}", world_size, rank
+
+    if init_method.startswith("tcp://"):
+        parsed = urlparse(init_method)
+        if parsed.hostname is None or parsed.port is None:
+            raise ValueError(f"Malformed tcp:// init_method: {init_method!r}")
+        if world_size < 0 or rank < 0:
+            raise ValueError(
+                "tcp:// rendezvous requires explicit world_size and rank "
+                "(as /root/reference/example_mp.py:37-42 passes them)")
+        return f"{parsed.hostname}:{parsed.port}", world_size, rank
+
+    raise ValueError(
+        f"Unsupported init_method {init_method!r}; use 'env://' or "
+        f"'tcp://host:port'")
+
+
+def rendezvous(init_method: Optional[str], world_size: int = -1,
+               rank: int = -1, timeout: Optional[float] = None) -> None:
+    """Join the coordination service (blocking, like the NCCL rendezvous).
+
+    Single-process configurations return immediately.  Multi-process: start
+    JAX's distributed client pointed at the coordinator; process 0 hosts the
+    service.  Safe to call once per process.
+    """
+    global _distributed_started
+    coordinator, num_processes, process_id = parse_init_method(
+        init_method, world_size, rank)
+    if coordinator is None or num_processes <= 1:
+        return
+
+    if _distributed_started:
+        return  # already joined
+    # NOTE: must not touch any backend-initializing JAX API here
+    # (jax.devices()/process_count()): jax.distributed.initialize has to run
+    # before XLA backends exist or it raises.
+    import jax
+
+    kwargs = {}
+    if timeout is not None:
+        kwargs["initialization_timeout"] = int(timeout)
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id, **kwargs)
+    _distributed_started = True
+
+
+def shutdown() -> None:
+    global _distributed_started
+    if _distributed_started:
+        import jax
+        jax.distributed.shutdown()
+        _distributed_started = False

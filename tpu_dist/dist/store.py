@@ -1,0 +1,511 @@
+"""Key-value stores for rendezvous/coordination — c10d Store parity.
+
+The reference's rendezvous rides torch's C++ TCPStore behind ``env://`` and
+``tcp://`` (/root/reference/mpspawn_dist.py:137-138, example_mp.py:18).  Here:
+
+- :class:`TCPStore` — native implementation: C++ server/client
+  (tpu_dist/csrc/tcpstore.cpp, built lazily via g++) speaking a
+  length-prefixed protocol; a pure-Python client/server of the *same*
+  protocol is the fallback when the toolchain is unavailable, so the two
+  interoperate (Python client ↔ C++ server and vice versa).
+- :class:`FileStore` — shared-filesystem store for single-host testing.
+
+API (torch Store parity): ``set/get/add/wait/check/delete_key/num_keys`` plus
+``barrier(world_size)`` built on ``add`` + a server-side blocking WAIT_GE.
+``get`` blocks until the key exists — the property rendezvous relies on.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import socket
+import struct
+import threading
+import time
+from typing import List, Optional
+
+__all__ = ["Store", "TCPStore", "FileStore", "PyTCPStoreServer"]
+
+# Wire protocol op codes (must match csrc/tcpstore.cpp).
+_OP_SET, _OP_GET, _OP_ADD, _OP_CHECK, _OP_DELETE, _OP_NUMKEYS, _OP_WAIT_GE = \
+    range(1, 8)
+
+
+class Store:
+    """Abstract store interface (torch.distributed.Store parity)."""
+
+    def set(self, key: str, value: bytes) -> None:
+        raise NotImplementedError
+
+    def get(self, key: str) -> bytes:
+        """Blocks until ``key`` exists, then returns its value."""
+        raise NotImplementedError
+
+    def add(self, key: str, delta: int) -> int:
+        raise NotImplementedError
+
+    def check(self, key: str) -> bool:
+        raise NotImplementedError
+
+    def delete_key(self, key: str) -> bool:
+        raise NotImplementedError
+
+    def num_keys(self) -> int:
+        raise NotImplementedError
+
+    def wait(self, keys: List[str], timeout: Optional[float] = None) -> None:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        for k in keys:
+            while not self.check(k):
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutError(f"wait timed out on key {k!r}")
+                time.sleep(0.01)
+
+    def barrier(self, world_size: int, tag: str = "default",
+                timeout: Optional[float] = None) -> None:
+        """All ``world_size`` callers block until everyone arrives.
+
+        Reusable with the same tag: the arrival counter only grows, and each
+        caller waits for the next full multiple of ``world_size`` (generation
+        scheme, as c10d's store barrier does).
+        """
+        key = f"__barrier__/{tag}"
+        n = self.add(key, 1)
+        generation = (n - 1) // world_size
+        self.wait_value_ge(key, (generation + 1) * world_size,
+                           timeout=timeout)
+
+    def wait_value_ge(self, key: str, target: int,
+                      timeout: Optional[float] = None) -> None:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self.add(key, 0) < target:
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"wait_value_ge timed out on {key!r}")
+            time.sleep(0.01)
+
+
+# ---------------------------------------------------------------------------
+# Pure-Python protocol server (fallback when g++/ctypes path unavailable;
+# same wire protocol as csrc/tcpstore.cpp, so clients interoperate).
+# ---------------------------------------------------------------------------
+
+class PyTCPStoreServer:
+    def __init__(self, port: int = 0):
+        self._kv = {}
+        self._mu = threading.Condition()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("0.0.0.0", port))
+        self._sock.listen(128)
+        self.port = self._sock.getsockname()[1]
+        self._stopping = False
+        self._threads: List[threading.Thread] = []
+        self._accept = threading.Thread(target=self._accept_loop, daemon=True)
+        self._accept.start()
+
+    def _accept_loop(self):
+        while not self._stopping:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                break
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = threading.Thread(target=self._handle, args=(conn,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    @staticmethod
+    def _recv_all(conn, n: int) -> Optional[bytes]:
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def _handle(self, conn):
+        try:
+            while not self._stopping:
+                hdr = self._recv_all(conn, 1)
+                if hdr is None:
+                    return
+                op = hdr[0]
+                raw = self._recv_all(conn, 4)
+                if raw is None:
+                    return
+                (klen,) = struct.unpack("<I", raw)
+                key = self._recv_all(conn, klen) if klen else b""
+                raw = self._recv_all(conn, 4)
+                if raw is None:
+                    return
+                (plen,) = struct.unpack("<I", raw)
+                payload = self._recv_all(conn, plen) if plen else b""
+                if key is None or payload is None:
+                    return
+                key = key.decode()
+                self._dispatch(conn, op, key, payload)
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _i64(b: bytes) -> int:
+        return struct.unpack("<q", b[:8].ljust(8, b"\0"))[0]
+
+    def _reply(self, conn, status: int, data: bytes = b""):
+        conn.sendall(struct.pack("<II", status, len(data)) + data)
+
+    def _dispatch(self, conn, op, key, payload):
+        if op == _OP_SET:
+            with self._mu:
+                self._kv[key] = payload
+                self._mu.notify_all()
+            self._reply(conn, 0)
+        elif op == _OP_GET:
+            with self._mu:
+                while key not in self._kv and not self._stopping:
+                    self._mu.wait(0.1)
+                if self._stopping:
+                    self._reply(conn, 1)
+                    return
+                val = self._kv[key]
+            self._reply(conn, 0, val)
+        elif op == _OP_ADD:
+            delta = self._i64(payload)
+            with self._mu:
+                cur = self._i64(self._kv.get(key, b""))
+                nv = cur + delta
+                self._kv[key] = struct.pack("<q", nv)
+                self._mu.notify_all()
+            self._reply(conn, 0, struct.pack("<q", nv))
+        elif op == _OP_CHECK:
+            with self._mu:
+                ok = key in self._kv
+            self._reply(conn, 0, b"1" if ok else b"0")
+        elif op == _OP_DELETE:
+            with self._mu:
+                existed = self._kv.pop(key, None) is not None
+            self._reply(conn, 0, b"1" if existed else b"0")
+        elif op == _OP_NUMKEYS:
+            with self._mu:
+                n = len(self._kv)
+            self._reply(conn, 0, struct.pack("<I", n))
+        elif op == _OP_WAIT_GE:
+            target = self._i64(payload)
+            with self._mu:
+                while (self._i64(self._kv.get(key, b"")) < target
+                       and not self._stopping):
+                    self._mu.wait(0.1)
+            self._reply(conn, 1 if self._stopping else 0)
+        else:
+            self._reply(conn, 2)
+
+    def stop(self):
+        self._stopping = True
+        with self._mu:
+            self._mu.notify_all()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class _PyClient:
+    """Pure-Python client for the store wire protocol."""
+
+    def __init__(self, host: str, port: int, timeout: float):
+        deadline = time.monotonic() + timeout
+        last_err = None
+        while True:
+            try:
+                self._sock = socket.create_connection((host, port), timeout=5)
+                break
+            except OSError as e:
+                last_err = e
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"could not connect to store at {host}:{port}: {e}")
+                time.sleep(0.05)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock.settimeout(None)  # GET/WAIT_GE block indefinitely
+        self._mu = threading.Lock()
+
+    def request(self, op: int, key: str, payload: bytes = b"") -> bytes:
+        kb = key.encode()
+        msg = (struct.pack("<BI", op, len(kb)) + kb
+               + struct.pack("<I", len(payload)) + payload)
+        with self._mu:
+            self._sock.sendall(msg)
+            hdr = PyTCPStoreServer._recv_all(self._sock, 8)
+            if hdr is None:
+                raise ConnectionError("store connection closed")
+            status, dlen = struct.unpack("<II", hdr)
+            data = (PyTCPStoreServer._recv_all(self._sock, dlen)
+                    if dlen else b"")
+        if status != 0:
+            raise RuntimeError(f"store request op={op} failed (status {status})")
+        return data or b""
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class _NativeClient:
+    """ctypes wrapper over the C++ client in libtpudist.so."""
+
+    def __init__(self, lib, host: str, port: int, timeout: float):
+        self._lib = lib
+        self._h = lib.tpudist_store_client_connect(
+            host.encode(), port, int(timeout * 1000))
+        if not self._h:
+            raise TimeoutError(f"could not connect to store at {host}:{port}")
+
+    def request(self, op: int, key: str, payload: bytes = b"") -> bytes:
+        lib, h, kb = self._lib, self._h, key.encode()
+        if op == _OP_SET:
+            buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload) \
+                if payload else None
+            if lib.tpudist_store_set(h, kb, buf, len(payload)) != 0:
+                raise RuntimeError("store set failed")
+            return b""
+        if op == _OP_GET:
+            out = ctypes.POINTER(ctypes.c_uint8)()
+            n = ctypes.c_int()
+            if lib.tpudist_store_get(h, kb, ctypes.byref(out),
+                                     ctypes.byref(n)) != 0:
+                raise RuntimeError("store get failed")
+            data = bytes(bytearray(out[i] for i in range(n.value)))
+            if n.value:
+                lib.tpudist_store_free(out)
+            return data
+        if op == _OP_ADD:
+            delta = struct.unpack("<q", payload[:8].ljust(8, b"\0"))[0]
+            result = ctypes.c_longlong()
+            if lib.tpudist_store_add(h, kb, delta,
+                                     ctypes.byref(result)) != 0:
+                raise ConnectionError("store add failed")
+            return struct.pack("<q", result.value)
+        if op == _OP_CHECK:
+            r = lib.tpudist_store_check(h, kb)
+            if r < 0:
+                raise ConnectionError("store check failed")
+            return b"1" if r == 1 else b"0"
+        if op == _OP_DELETE:
+            r = lib.tpudist_store_delete(h, kb)
+            if r < 0:
+                raise ConnectionError("store delete failed")
+            return b"1" if r == 1 else b"0"
+        if op == _OP_NUMKEYS:
+            r = lib.tpudist_store_num_keys(h)
+            if r < 0:
+                raise ConnectionError("store num_keys failed")
+            return struct.pack("<I", r)
+        if op == _OP_WAIT_GE:
+            target = struct.unpack("<q", payload[:8].ljust(8, b"\0"))[0]
+            if lib.tpudist_store_wait_ge(h, kb, target) != 0:
+                raise RuntimeError("store wait_ge failed")
+            return b""
+        raise ValueError(f"bad op {op}")
+
+    def close(self):
+        if self._h:
+            self._lib.tpudist_store_client_close(self._h)
+            self._h = None
+
+
+_native_lib = None
+_native_tried = False
+
+
+def _load_native():
+    global _native_lib, _native_tried
+    if _native_tried:
+        return _native_lib
+    _native_tried = True
+    if os.environ.get("TPU_DIST_PURE_PYTHON_STORE"):
+        return None
+    try:
+        from ..csrc.build import ensure_built
+        lib = ctypes.CDLL(ensure_built())
+    except Exception:
+        return None
+    lib.tpudist_store_server_start.restype = ctypes.c_void_p
+    lib.tpudist_store_server_start.argtypes = [ctypes.c_int]
+    lib.tpudist_store_server_port.restype = ctypes.c_int
+    lib.tpudist_store_server_port.argtypes = [ctypes.c_void_p]
+    lib.tpudist_store_server_stop.argtypes = [ctypes.c_void_p]
+    lib.tpudist_store_client_connect.restype = ctypes.c_void_p
+    lib.tpudist_store_client_connect.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+    lib.tpudist_store_client_close.argtypes = [ctypes.c_void_p]
+    lib.tpudist_store_set.restype = ctypes.c_int
+    lib.tpudist_store_set.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int]
+    lib.tpudist_store_get.restype = ctypes.c_int
+    lib.tpudist_store_get.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.c_int)]
+    lib.tpudist_store_add.restype = ctypes.c_int
+    lib.tpudist_store_add.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong,
+        ctypes.POINTER(ctypes.c_longlong)]
+    lib.tpudist_store_check.restype = ctypes.c_int
+    lib.tpudist_store_check.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.tpudist_store_delete.restype = ctypes.c_int
+    lib.tpudist_store_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.tpudist_store_num_keys.restype = ctypes.c_int
+    lib.tpudist_store_num_keys.argtypes = [ctypes.c_void_p]
+    lib.tpudist_store_wait_ge.restype = ctypes.c_int
+    lib.tpudist_store_wait_ge.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong]
+    lib.tpudist_store_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+    _native_lib = lib
+    return lib
+
+
+class TCPStore(Store):
+    """TCP key-value store (c10d TCPStore parity).
+
+    ``is_master=True`` additionally hosts the server (native C++ when the
+    toolchain allows, else the in-process Python server); every instance is
+    a client.  ``port=0`` with ``is_master`` picks a free port (see
+    ``.port``).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 is_master: bool = False, timeout: float = 300.0):
+        lib = _load_native()
+        self._server = None
+        self._native_server = None
+        if is_master:
+            if lib is not None:
+                self._native_server = lib.tpudist_store_server_start(port)
+                if not self._native_server:
+                    raise OSError(f"could not bind store server on port {port}")
+                port = lib.tpudist_store_server_port(self._native_server)
+            else:
+                self._server = PyTCPStoreServer(port)
+                port = self._server.port
+            host = "127.0.0.1" if host in ("0.0.0.0", "") else host
+        self.host, self.port = host, port
+        self.native = lib is not None
+        self._client = (_NativeClient(lib, host, port, timeout)
+                        if lib is not None
+                        else _PyClient(host, port, timeout))
+
+    # -- Store API -----------------------------------------------------------
+    def set(self, key: str, value) -> None:
+        if isinstance(value, str):
+            value = value.encode()
+        self._client.request(_OP_SET, key, bytes(value))
+
+    def get(self, key: str) -> bytes:
+        return self._client.request(_OP_GET, key)
+
+    def add(self, key: str, delta: int) -> int:
+        out = self._client.request(_OP_ADD, key, struct.pack("<q", delta))
+        return struct.unpack("<q", out)[0]
+
+    def check(self, key: str) -> bool:
+        return self._client.request(_OP_CHECK, key) == b"1"
+
+    def delete_key(self, key: str) -> bool:
+        return self._client.request(_OP_DELETE, key) == b"1"
+
+    def num_keys(self) -> int:
+        return struct.unpack(
+            "<I", self._client.request(_OP_NUMKEYS, ""))[0]
+
+    def wait_value_ge(self, key: str, target: int,
+                      timeout: Optional[float] = None) -> None:
+        # Server-side blocking wait (no polling); timeout falls back to poll.
+        if timeout is None:
+            self._client.request(_OP_WAIT_GE, key, struct.pack("<q", target))
+        else:
+            super().wait_value_ge(key, target, timeout)
+
+    def close(self) -> None:
+        self._client.close()
+        if self._server is not None:
+            self._server.stop()
+            self._server = None
+        if self._native_server:
+            _native_lib.tpudist_store_server_stop(self._native_server)
+            self._native_server = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class FileStore(Store):
+    """Shared-filesystem store — single-host testing convenience."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self._mu = threading.Lock()
+
+    def _file(self, key: str) -> str:
+        safe = key.replace("/", "_slash_")
+        return os.path.join(self.path, safe)
+
+    def set(self, key: str, value) -> None:
+        if isinstance(value, str):
+            value = value.encode()
+        tmp = self._file(key) + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(bytes(value))
+        os.replace(tmp, self._file(key))
+
+    def get(self, key: str) -> bytes:
+        while not os.path.exists(self._file(key)):
+            time.sleep(0.01)
+        with open(self._file(key), "rb") as f:
+            return f.read()
+
+    def add(self, key: str, delta: int) -> int:
+        # Cross-process atomicity via a lockfile.
+        lock = self._file(key) + ".lock"
+        while True:
+            try:
+                fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                break
+            except FileExistsError:
+                time.sleep(0.005)
+        try:
+            cur = 0
+            if os.path.exists(self._file(key)):
+                with open(self._file(key), "rb") as f:
+                    raw = f.read()
+                cur = struct.unpack("<q", raw[:8].ljust(8, b"\0"))[0]
+            nv = cur + delta
+            self.set(key, struct.pack("<q", nv))
+            return nv
+        finally:
+            os.close(fd)
+            os.unlink(lock)
+
+    def check(self, key: str) -> bool:
+        return os.path.exists(self._file(key))
+
+    def delete_key(self, key: str) -> bool:
+        try:
+            os.unlink(self._file(key))
+            return True
+        except FileNotFoundError:
+            return False
+
+    def num_keys(self) -> int:
+        return len([f for f in os.listdir(self.path)
+                    if not f.endswith((".tmp", ".lock"))])
