@@ -1,0 +1,261 @@
+"""Data pipeline: transforms, datasets, loader batching, device prefetch."""
+
+import numpy as np
+import pytest
+
+import tpu_dist.dist as dist
+from tpu_dist.data import (ArrayImageDataset, CIFAR10, DataLoader,
+                           DeviceLoader, DistributedSampler, MNIST,
+                           TensorDataset, default_collate, transforms)
+
+
+class TestTransforms:
+    def test_to_float_scales_uint8(self):
+        x = np.full((2, 4, 4, 1), 255, np.uint8)
+        out = transforms.ToFloat()(x)
+        assert out.dtype == np.float32 and out.max() == 1.0
+
+    def test_normalize(self):
+        x = np.ones((2, 4, 4, 3), np.float32) * 0.5
+        t = transforms.Normalize((0.5, 0.5, 0.5), (0.25, 0.5, 1.0))
+        out = t(x)
+        np.testing.assert_allclose(out, 0.0)
+
+    def test_normalize_zero_std_raises(self):
+        with pytest.raises(ValueError, match="std"):
+            transforms.Normalize((0.0,), (0.0,))
+
+    def test_random_crop_shape_and_determinism(self):
+        rng = np.random.default_rng(0)
+        x = np.arange(2 * 32 * 32 * 3, dtype=np.float32).reshape(2, 32, 32, 3)
+        t = transforms.RandomCrop(32, padding=4)
+        a = t(x, np.random.default_rng(42))
+        b = t(x, np.random.default_rng(42))
+        c = t(x, np.random.default_rng(43))
+        assert a.shape == (2, 32, 32, 3)
+        np.testing.assert_array_equal(a, b)
+        assert not np.array_equal(a, c)
+
+    def test_random_crop_content_is_window(self):
+        # with padding=0 a crop of a smaller window must be a slice
+        x = np.arange(1 * 8 * 8 * 1, dtype=np.float32).reshape(1, 8, 8, 1)
+        t = transforms.RandomCrop(4, padding=0)
+        out = t(x, np.random.default_rng(1))
+        # the window must appear contiguously in x
+        found = any(
+            np.array_equal(out[0, :, :, 0], x[0, i:i+4, j:j+4, 0])
+            for i in range(5) for j in range(5))
+        assert found
+
+    def test_random_crop_requires_rng(self):
+        with pytest.raises(ValueError, match="rng"):
+            transforms.RandomCrop(4)(np.zeros((1, 8, 8, 1), np.float32))
+
+    def test_hflip(self):
+        x = np.arange(4 * 2 * 3 * 1, dtype=np.float32).reshape(4, 2, 3, 1)
+        t = transforms.RandomHorizontalFlip(p=1.0)
+        out = t(x, np.random.default_rng(0))
+        np.testing.assert_array_equal(out, x[:, :, ::-1, :])
+        t0 = transforms.RandomHorizontalFlip(p=0.0)
+        np.testing.assert_array_equal(t0(x, np.random.default_rng(0)), x)
+
+    def test_compose(self):
+        t = transforms.Compose([transforms.ToFloat(),
+                                transforms.Normalize((0.0,), (2.0,))])
+        out = t(np.full((1, 2, 2, 1), 255, np.uint8))
+        np.testing.assert_allclose(out, 0.5)
+
+
+class TestDatasets:
+    def test_synthetic_mnist(self):
+        ds = MNIST(root="/nonexistent", train=True, synthetic_fallback=True)
+        assert ds.data.shape == (60000, 28, 28, 1)
+        assert ds.data.dtype == np.uint8
+        assert ds.targets.shape == (60000,)
+        x, y = ds[5]
+        assert x.shape == (28, 28, 1)
+
+    def test_synthetic_cifar(self):
+        ds = CIFAR10(root="/nonexistent", train=False, synthetic_fallback=True)
+        assert ds.data.shape == (10000, 32, 32, 3)
+
+    def test_missing_raises_with_hint(self):
+        with pytest.raises(FileNotFoundError, match="SYNTHETIC"):
+            MNIST(root="/nonexistent", synthetic_fallback=False)
+
+    def test_synthetic_deterministic(self):
+        a = MNIST(root="/x", synthetic_fallback=True)
+        b = MNIST(root="/x", synthetic_fallback=True)
+        np.testing.assert_array_equal(a.data[:100], b.data[:100])
+
+    def test_idx_roundtrip(self, tmp_path):
+        # write a tiny IDX pair and read it back through MNIST
+        import struct
+        raw = tmp_path / "MNIST" / "raw"
+        raw.mkdir(parents=True)
+        imgs = np.arange(3 * 28 * 28, dtype=np.uint8).reshape(3, 28, 28)
+        lbls = np.array([7, 1, 4], np.uint8)
+        with open(raw / "train-images-idx3-ubyte", "wb") as f:
+            f.write(struct.pack(">IIII", 0x803, 3, 28, 28) + imgs.tobytes())
+        with open(raw / "train-labels-idx1-ubyte", "wb") as f:
+            f.write(struct.pack(">II", 0x801, 3) + lbls.tobytes())
+        ds = MNIST(root=str(tmp_path), train=True)
+        assert ds.data.shape == (3, 28, 28, 1)
+        np.testing.assert_array_equal(ds.targets, [7, 1, 4])
+        np.testing.assert_array_equal(ds.data[1, :, :, 0], imgs[1])
+
+    def test_tensor_dataset(self):
+        td = TensorDataset(np.arange(10), np.arange(10) * 2)
+        assert len(td) == 10
+        assert td[3] == (3, 6)
+        with pytest.raises(ValueError, match="size mismatch"):
+            TensorDataset(np.arange(3), np.arange(4))
+
+
+class TestDataLoader:
+    def _mnist(self, n=64):
+        from tpu_dist.data.datasets import synthetic_mnist_arrays
+        x, y = synthetic_mnist_arrays(True, n=n)
+        return ArrayImageDataset(x, y)
+
+    def test_batch_shapes_and_scaling(self):
+        dl = DataLoader(self._mnist(), batch_size=16)
+        xb, yb = next(iter(dl))
+        assert xb.shape == (16, 28, 28, 1) and xb.dtype == np.float32
+        assert 0.0 <= xb.min() and xb.max() <= 1.0
+        assert yb.shape == (16,)
+        assert len(dl) == 4
+
+    def test_drop_last(self):
+        dl = DataLoader(self._mnist(10), batch_size=4, drop_last=True)
+        assert [len(b[1]) for b in dl] == [4, 4]
+
+    def test_transform_applied_batched(self):
+        ds = self._mnist()
+        ds.transform = transforms.Normalize((0.1307,), (0.3081,))
+        dl = DataLoader(ds, batch_size=8)
+        xb, _ = next(iter(dl))
+        assert xb.min() < 0  # normalization shifted below zero
+
+    def test_distributed_sampler_integration(self):
+        ds = self._mnist(64)
+        out = []
+        for r in range(4):
+            s = DistributedSampler(ds, 4, r, shuffle=False)
+            dl = DataLoader(ds, batch_size=8, sampler=s)
+            for _, yb in dl:
+                out.extend(yb.tolist())
+        assert len(out) == 64  # every sample seen exactly once over ranks
+
+    def test_shuffle_changes_with_epoch(self):
+        dl = DataLoader(self._mnist(), batch_size=64, shuffle=True)
+        _, y0 = next(iter(dl))
+        dl.set_epoch(1)
+        _, y1 = next(iter(dl))
+        assert y0.tolist() != y1.tolist()
+
+    def test_shuffle_and_sampler_conflict(self):
+        ds = self._mnist()
+        with pytest.raises(ValueError, match="exclusive"):
+            DataLoader(ds, sampler=DistributedSampler(ds, 1, 0), shuffle=True)
+
+    def test_num_workers_prefetch_same_data(self):
+        ds = self._mnist()
+        a = [yb.tolist() for _, yb in DataLoader(ds, batch_size=16)]
+        b = [yb.tolist() for _, yb in
+             DataLoader(ds, batch_size=16, num_workers=2)]
+        assert a == b
+
+    def test_early_abandon_unblocks_producer(self):
+        import threading
+        ds = self._mnist(640)
+        before = threading.active_count()
+        for _ in range(5):
+            it = iter(DataLoader(ds, batch_size=8, num_workers=2))
+            next(it)
+            it.close()  # abandon mid-epoch (the --max-steps break)
+        import time
+        time.sleep(0.5)  # producers must notice stop and exit
+        assert threading.active_count() <= before + 1
+
+    def test_worker_error_propagates(self):
+        class Bad:
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                raise RuntimeError("boom")
+
+        dl = DataLoader(Bad(), batch_size=2, num_workers=1)
+        with pytest.raises(RuntimeError, match="boom"):
+            list(dl)
+
+    def test_augmentation_rng_distinct_per_rank(self):
+        from tpu_dist.data.datasets import synthetic_cifar10_arrays
+        x, y = synthetic_cifar10_arrays(True, n=32)
+        batches = []
+        for r in range(2):
+            ds = ArrayImageDataset(x, y,
+                                   transform=transforms.RandomCrop(32, 4))
+            s = DistributedSampler(ds, 2, r, shuffle=False)
+            dl = DataLoader(ds, batch_size=16, sampler=s)
+            xb, _ = next(iter(dl))
+            batches.append(xb)
+        # different shards AND different augmentation streams
+        assert batches[0].shape == batches[1].shape
+        assert not np.array_equal(batches[0], batches[1])
+
+    def test_generic_dataset_collate(self):
+        class Pairs:
+            def __len__(self):
+                return 6
+
+            def __getitem__(self, i):
+                return np.full((2,), i), i % 3
+
+        dl = DataLoader(Pairs(), batch_size=3)
+        xb, yb = next(iter(dl))
+        assert xb.shape == (3, 2) and yb.tolist() == [0, 1, 2]
+
+
+class TestDeviceLoader:
+    def test_places_on_mesh(self):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        if dist.is_initialized():
+            dist.destroy_process_group()
+        pg = dist.init_process_group()
+        try:
+            ds = ArrayImageDataset(
+                *__import__("tpu_dist.data.datasets",
+                            fromlist=["synthetic_mnist_arrays"]
+                            ).synthetic_mnist_arrays(True, n=64))
+            dl = DeviceLoader(DataLoader(ds, batch_size=16), group=pg)
+            seen = 0
+            for xb, yb in dl:
+                assert isinstance(xb, jax.Array)
+                assert xb.sharding.spec == P(pg.axis_name)
+                assert len(xb.sharding.device_set) == 8
+                seen += 1
+            assert seen == 4 == len(dl)
+        finally:
+            dist.destroy_process_group()
+
+    def test_same_values_as_plain_loader(self):
+        if dist.is_initialized():
+            dist.destroy_process_group()
+        pg = dist.init_process_group()
+        try:
+            ds = ArrayImageDataset(
+                *__import__("tpu_dist.data.datasets",
+                            fromlist=["synthetic_mnist_arrays"]
+                            ).synthetic_mnist_arrays(True, n=32))
+            plain = [b for b in DataLoader(ds, batch_size=8)]
+            dev = [b for b in DeviceLoader(DataLoader(ds, batch_size=8),
+                                           group=pg)]
+            for (px, py), (dx, dy) in zip(plain, dev):
+                np.testing.assert_allclose(px, np.asarray(dx))
+                np.testing.assert_array_equal(py, np.asarray(dy))
+        finally:
+            dist.destroy_process_group()

@@ -138,6 +138,22 @@ class TestRendezvousParsing:
         with pytest.raises(ValueError, match="MASTER_ADDR"):
             dist.parse_init_method("env://")
 
+    def test_env_missing_world_size_fails_fast(self, monkeypatch):
+        # no silent degradation to N independent single-process worlds
+        monkeypatch.setenv("MASTER_ADDR", "10.0.0.1")
+        monkeypatch.setenv("MASTER_PORT", "29500")
+        monkeypatch.delenv("WORLD_SIZE", raising=False)
+        with pytest.raises(ValueError, match="WORLD_SIZE"):
+            dist.parse_init_method("env://")
+
+    def test_env_missing_rank_fails_fast(self, monkeypatch):
+        monkeypatch.setenv("MASTER_ADDR", "10.0.0.1")
+        monkeypatch.setenv("MASTER_PORT", "29500")
+        monkeypatch.setenv("WORLD_SIZE", "4")
+        monkeypatch.delenv("RANK", raising=False)
+        with pytest.raises(ValueError, match="RANK"):
+            dist.parse_init_method("env://")
+
     def test_tcp_url(self):
         # the reference's style: /root/reference/example_mp.py:18,37-42
         assert dist.parse_init_method("tcp://10.157.106.151:12345",

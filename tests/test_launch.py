@@ -1,0 +1,134 @@
+"""Launchers: spawn semantics + launch CLI env contract (SURVEY.md §2 #13-14)."""
+
+import os
+import subprocess
+import sys
+import tempfile
+import textwrap
+
+import pytest
+
+from tpu_dist.launch import (ProcessExitedException, ProcessRaisedException,
+                             spawn)
+from tpu_dist.launch.cli import build_parser, main
+
+pytestmark = pytest.mark.multiprocess
+
+
+# -- spawn helpers must be module-level (picklable) ---------------------------
+
+def _ok_worker(i, path):
+    with open(os.path.join(path, f"rank{i}"), "w") as f:
+        f.write(str(i))
+
+
+def _boom_worker(i):
+    if i == 1:
+        raise RuntimeError("boom from rank 1")
+    import time
+    time.sleep(30)  # siblings must be terminated, not joined
+
+
+def _exit_worker(i):
+    if i == 0:
+        sys.exit(3)
+    import time
+    time.sleep(30)
+
+
+class TestSpawn:
+    def test_runs_all_ranks(self, tmp_path):
+        spawn(_ok_worker, args=(str(tmp_path),), nprocs=3)
+        assert sorted(os.listdir(tmp_path)) == ["rank0", "rank1", "rank2"]
+
+    def test_child_exception_propagates_and_kills_siblings(self):
+        import time
+        t0 = time.time()
+        with pytest.raises(ProcessRaisedException, match="boom from rank 1"):
+            spawn(_boom_worker, nprocs=3)
+        assert time.time() - t0 < 25  # siblings terminated, not waited out
+
+    def test_child_exit_code(self):
+        with pytest.raises(ProcessExitedException) as ei:
+            spawn(_exit_worker, nprocs=2)
+        assert ei.value.exit_code == 3
+
+    def test_bad_nprocs(self):
+        with pytest.raises(ValueError, match="nprocs"):
+            spawn(_ok_worker, nprocs=0)
+
+    def test_nonblocking_context(self, tmp_path):
+        ctx = spawn(_ok_worker, args=(str(tmp_path),), nprocs=2, join=False)
+        assert len(ctx.pids()) == 2
+        assert ctx.join()
+
+
+_ENV_SCRIPT = textwrap.dedent("""
+    import json, os, sys
+    out = {k: os.environ.get(k) for k in
+           ("RANK", "LOCAL_RANK", "WORLD_SIZE", "LOCAL_WORLD_SIZE",
+            "NODE_RANK", "MASTER_ADDR", "MASTER_PORT")}
+    with open(sys.argv[1] + "/" + out["RANK"] + ".json", "w") as f:
+        json.dump(out, f)
+""")
+
+
+class TestLaunchCLI:
+    def test_env_contract(self, tmp_path):
+        script = tmp_path / "probe.py"
+        script.write_text(_ENV_SCRIPT)
+        rc = main(["--nproc_per_node=2", "--nnodes=2", "--node_rank=1",
+                   "--master_addr=10.1.2.3", "--master_port=12345",
+                   str(script), str(tmp_path)])
+        assert rc == 0
+        import json
+        # node_rank=1, nproc=2 → global ranks 2 and 3
+        for local in range(2):
+            rank = 2 + local
+            with open(tmp_path / f"{rank}.json") as f:
+                env = json.load(f)
+            assert env == {"RANK": str(rank), "LOCAL_RANK": str(local),
+                           "WORLD_SIZE": "4", "LOCAL_WORLD_SIZE": "2",
+                           "NODE_RANK": "1", "MASTER_ADDR": "10.1.2.3",
+                           "MASTER_PORT": "12345"}
+
+    def test_fail_fast(self, tmp_path):
+        script = tmp_path / "failer.py"
+        script.write_text(textwrap.dedent("""
+            import os, sys, time
+            if os.environ["RANK"] == "0":
+                sys.exit(7)
+            time.sleep(30)
+        """))
+        import time
+        t0 = time.time()
+        rc = main(["--nproc_per_node=2", str(script)])
+        assert rc == 7
+        assert time.time() - t0 < 25
+
+    def test_script_args_passthrough(self, tmp_path):
+        script = tmp_path / "echo.py"
+        script.write_text(textwrap.dedent("""
+            import sys
+            with open(sys.argv[1], "w") as f:
+                f.write(" ".join(sys.argv[2:]))
+        """))
+        out = tmp_path / "out.txt"
+        rc = main(["--nproc_per_node=1", str(script), str(out),
+                   "--epochs", "5", "-g", "8"])
+        assert rc == 0
+        assert out.read_text() == "--epochs 5 -g 8"
+
+    def test_bad_node_rank(self):
+        assert main(["--nnodes=2", "--node_rank=2", "x.py"]) == 2
+
+    def test_module_mode_subprocess(self, tmp_path):
+        # run the CLI as a real subprocess end-to-end
+        script = tmp_path / "p.py"
+        script.write_text(_ENV_SCRIPT)
+        r = subprocess.run(
+            [sys.executable, "-m", "tpu_dist.launch", "--nproc_per_node=1",
+             str(script), str(tmp_path)],
+            cwd="/root/repo", capture_output=True, text=True, timeout=60)
+        assert r.returncode == 0, r.stderr
+        assert (tmp_path / "0.json").exists()

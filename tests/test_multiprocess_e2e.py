@@ -1,0 +1,82 @@
+"""End-to-end multi-process rendezvous: launch CLI → env:// →
+jax.distributed.initialize → cross-process mesh + collective.
+
+This is the reference's 2-node scenario (/root/reference/README.md:341-343)
+run as 2 real OS processes on the CPU backend — the closest a single host
+gets to multi-host DCN rendezvous (SURVEY.md §4: multi-host tests without a
+pod)."""
+
+import json
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+pytestmark = [pytest.mark.multiprocess, pytest.mark.slow]
+
+_WORKER = textwrap.dedent("""
+    import json, os, sys
+    # must configure platform BEFORE importing jax (child inherits no runtime);
+    # 4 virtual devices per process = the TPU topology (one host process
+    # driving several cores): 2 processes x 4 devices -> device world 8
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import tpu_dist.dist as dist
+    from tpu_dist import collectives as C
+    import numpy as np
+
+    pg = dist.init_process_group(backend="cpu", init_method="env://")
+    rank = dist.get_rank()
+
+    # world = 2 processes x 1 cpu device each
+    out = {
+        "rank": rank,
+        "num_processes": dist.get_num_processes(),
+        "world_size": dist.get_world_size(),
+        "local_world_size": dist.get_local_world_size(),
+    }
+
+    # eager cross-process collectives
+    s = C.all_reduce_host(np.array([float(rank + 1)]), group=pg)
+    out["allreduce_sum"] = float(np.asarray(s)[0])
+    g = C.all_gather_host(np.array([rank]), group=pg)
+    out["gathered"] = np.asarray(g).ravel().tolist()
+    b = C.broadcast_host(np.array([rank * 10.0]), group=pg, src=1)
+    out["broadcast"] = float(np.asarray(b)[0])
+
+    dist.barrier()
+    with open(sys.argv[1] + f"/result{rank}.json", "w") as f:
+        json.dump(out, f)
+    dist.destroy_process_group()
+""")
+
+
+@pytest.mark.parametrize("nproc", [2])
+def test_env_rendezvous_two_processes(tmp_path, nproc):
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    import os
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_dist.launch",
+         f"--nproc_per_node={nproc}", "--master_port=29711",
+         str(script), str(tmp_path)],
+        cwd="/root/repo", env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    results = {}
+    for rank in range(nproc):
+        with open(tmp_path / f"result{rank}.json") as f:
+            results[rank] = json.load(f)
+    for rank, res in results.items():
+        assert res["rank"] == rank
+        assert res["num_processes"] == nproc
+        assert res["world_size"] == nproc * 4  # 4 virtual devices/process
+        assert res["local_world_size"] == 4
+        assert res["allreduce_sum"] == 3.0  # 1 + 2
+        assert res["gathered"] == [0, 1]
+        assert res["broadcast"] == 10.0  # src=1's value

@@ -1,0 +1,161 @@
+"""Sampler index math (SURVEY.md §4: padding, disjointness, epoch reshuffle)
+— checked both as properties and directly against torch's DistributedSampler
+(torch is available CPU-only in this image)."""
+
+import numpy as np
+import pytest
+
+from tpu_dist.data import (BatchSampler, DistributedSampler, RandomSampler,
+                           SequentialSampler)
+
+
+class _Sized:
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+
+class TestDistributedSamplerProperties:
+    @pytest.mark.parametrize("n,world", [(100, 8), (101, 8), (7, 8),
+                                         (64, 8), (1000, 16), (10, 3)])
+    def test_cover_and_padding(self, n, world):
+        ds = _Sized(n)
+        all_idx = []
+        lens = set()
+        for r in range(world):
+            s = DistributedSampler(ds, num_replicas=world, rank=r,
+                                   shuffle=False)
+            idx = list(s)
+            lens.add(len(idx))
+            assert len(idx) == len(s)
+            all_idx.extend(idx)
+        assert len(lens) == 1  # equal shard sizes
+        assert set(all_idx) == set(range(n))  # full coverage
+        assert len(all_idx) == -(-n // world) * world  # padded total
+
+    def test_disjoint_when_divisible(self):
+        ds = _Sized(64)
+        shards = [set(DistributedSampler(ds, 8, r, shuffle=False))
+                  for r in range(8)]
+        for i in range(8):
+            for j in range(i + 1, 8):
+                assert not shards[i] & shards[j]
+
+    def test_drop_last_truncates(self):
+        ds = _Sized(101)
+        total = sum(len(list(DistributedSampler(ds, 8, r, shuffle=False,
+                                                drop_last=True)))
+                    for r in range(8))
+        assert total == 96
+
+    def test_set_epoch_reshuffles(self):
+        ds = _Sized(100)
+        s = DistributedSampler(ds, 4, 0, shuffle=True, seed=7)
+        a = list(s)
+        s.set_epoch(1)
+        b = list(s)
+        assert a != b
+        s.set_epoch(0)
+        assert list(s) == a  # deterministic per epoch
+
+    def test_no_shuffle_is_strided(self):
+        ds = _Sized(16)
+        s = DistributedSampler(ds, 4, 1, shuffle=False)
+        assert list(s) == [1, 5, 9, 13]
+
+    def test_shuffle_epoch_consistent_across_ranks(self):
+        # all ranks must agree on the permutation each epoch
+        ds = _Sized(40)
+        perms = []
+        for r in range(4):
+            s = DistributedSampler(ds, 4, r, shuffle=True, seed=3)
+            s.set_epoch(5)
+            perms.append(list(s))
+        joined = sorted(i for p in perms for i in p)
+        assert joined == sorted(range(40))
+
+    def test_bad_rank_raises(self):
+        with pytest.raises(ValueError, match="rank"):
+            DistributedSampler(_Sized(10), num_replicas=4, rank=4)
+
+    def test_defaults_from_group(self):
+        import tpu_dist.dist as dist
+        if dist.is_initialized():
+            dist.destroy_process_group()
+        dist.init_process_group()
+        try:
+            s = DistributedSampler(_Sized(16), shuffle=False)
+            # single process ⇒ one shard covering everything
+            assert s.num_replicas == 1 and s.rank == 0
+            assert list(s) == list(range(16))
+        finally:
+            dist.destroy_process_group()
+
+
+class TestTorchParity:
+    """Same (n, world, drop_last) inputs → identical shard sets/sizes as
+    torch.utils.data.distributed.DistributedSampler (shuffle=False compares
+    exact sequences; shuffle=True compares partition structure — the PRNGs
+    differ by design)."""
+
+    @pytest.mark.parametrize("n,world,drop_last", [
+        (100, 8, False), (101, 8, False), (101, 8, True),
+        (7, 8, False), (1000, 16, False), (33, 5, True)])
+    def test_no_shuffle_exact(self, n, world, drop_last):
+        torch = pytest.importorskip("torch")
+        from torch.utils.data.distributed import DistributedSampler as TorchDS
+
+        ds = _Sized(n)
+        for r in range(world):
+            ours = list(DistributedSampler(ds, world, r, shuffle=False,
+                                           drop_last=drop_last))
+            theirs = list(TorchDS(ds, num_replicas=world, rank=r,
+                                  shuffle=False, drop_last=drop_last))
+            assert ours == theirs, (n, world, r, drop_last)
+
+    @pytest.mark.parametrize("n,world", [(100, 8), (101, 8), (63, 4)])
+    def test_shuffle_structure(self, n, world):
+        torch = pytest.importorskip("torch")
+        from torch.utils.data.distributed import DistributedSampler as TorchDS
+
+        ds = _Sized(n)
+        ours_all, theirs_all = [], []
+        for r in range(world):
+            s = DistributedSampler(ds, world, r, shuffle=True, seed=0)
+            s.set_epoch(2)
+            t = TorchDS(ds, num_replicas=world, rank=r, shuffle=True, seed=0)
+            t.set_epoch(2)
+            ours, theirs = list(s), list(t)
+            assert len(ours) == len(theirs)
+            ours_all.extend(ours)
+            theirs_all.extend(theirs)
+        # identical structure: same total length, full coverage; which
+        # elements get duplicated as padding depends on the permutation, and
+        # the PRNGs differ by design (numpy vs torch randperm)
+        assert len(ours_all) == len(theirs_all)
+        assert set(ours_all) == set(theirs_all) == set(range(n))
+
+
+class TestBatchSampler:
+    def test_batches(self):
+        bs = BatchSampler(SequentialSampler(_Sized(10)), 3, drop_last=False)
+        assert list(bs) == [[0, 1, 2], [3, 4, 5], [6, 7, 8], [9]]
+        assert len(bs) == 4
+
+    def test_drop_last(self):
+        bs = BatchSampler(SequentialSampler(_Sized(10)), 3, drop_last=True)
+        assert list(bs) == [[0, 1, 2], [3, 4, 5], [6, 7, 8]]
+        assert len(bs) == 3
+
+    def test_bad_batch_size(self):
+        with pytest.raises(ValueError, match="batch_size"):
+            BatchSampler(SequentialSampler(_Sized(4)), 0, False)
+
+    def test_random_sampler_epoch(self):
+        rs = RandomSampler(_Sized(20), seed=1)
+        a = list(rs)
+        rs.set_epoch(3)
+        assert list(rs) != a
+        assert sorted(a) == list(range(20))

@@ -9,10 +9,13 @@ TPU.  Currently shipped subpackages:
 - ``tpu_dist.models`` — reference workloads (MNIST ConvNet, ResNet-18/34/50)
 - ``tpu_dist.dist`` — process groups, rendezvous, TCP/File stores (c10d)
 - ``tpu_dist.collectives`` — in-jit (psum/ring) + eager collectives
+- ``tpu_dist.data`` — samplers, datasets, transforms, device prefetch
+- ``tpu_dist.parallel`` — DistributedDataParallel (fused-psum train step)
 """
 
 __version__ = "0.1.0"
 
-from . import collectives, dist, models, nn, optim
+from . import collectives, data, dist, models, nn, optim, parallel
 
-__all__ = ["nn", "optim", "models", "dist", "collectives", "__version__"]
+__all__ = ["nn", "optim", "models", "dist", "collectives", "data",
+           "parallel", "__version__"]

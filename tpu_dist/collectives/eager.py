@@ -15,11 +15,7 @@ from torch.distributed working at world_size=1).
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Optional
-
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["all_reduce_host", "all_gather_host", "broadcast_host"]

@@ -24,6 +24,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
@@ -240,6 +241,10 @@ struct Server {
       }
     }
     ::close(fd);
+    // Prune so stop() never calls shutdown() on a reused fd number.
+    std::lock_guard<std::mutex> g(handlers_mu);
+    client_fds.erase(std::remove(client_fds.begin(), client_fds.end(), fd),
+                     client_fds.end());
   }
 };
 

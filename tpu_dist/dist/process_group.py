@@ -70,7 +70,6 @@ __all__ = [
 # assume this unless a group was built with custom axes.
 DATA_AXIS = "data"
 
-_state = threading.local()
 _DEFAULT_GROUP: Optional["ProcessGroup"] = None
 _lock = threading.Lock()
 

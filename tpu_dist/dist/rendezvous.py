@@ -53,8 +53,18 @@ def parse_init_method(init_method: Optional[str],
                 "env vars (set by tpu_dist.launch or by hand, as the "
                 "reference does at mpspawn_dist.py:137-138)")
         if world_size < 0:
-            world_size = int(os.environ.get("WORLD_SIZE", 1))
+            if "WORLD_SIZE" not in os.environ:
+                # Fail fast rather than silently training N independent
+                # single-process worlds (torch env:// requires it too).
+                raise ValueError(
+                    "init_method='env://' requires WORLD_SIZE (env var or "
+                    "world_size= argument)")
+            world_size = int(os.environ["WORLD_SIZE"])
         if rank < 0:
+            if "RANK" not in os.environ and world_size > 1:
+                raise ValueError(
+                    "init_method='env://' requires RANK (env var or rank= "
+                    "argument) when WORLD_SIZE > 1")
             rank = int(os.environ.get("RANK", 0))
         return f"{addr}:{port}", world_size, rank
 

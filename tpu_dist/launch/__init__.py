@@ -1,0 +1,9 @@
+"""tpu_dist.launch — process bring-up (L5 of SURVEY.md §1): ``spawn`` (the
+mp.spawn analogue) and the ``python -m tpu_dist.launch`` CLI (the
+torch.distributed.launch analogue)."""
+
+from .spawn import (ProcessContext, ProcessExitedException,
+                    ProcessRaisedException, spawn)
+
+__all__ = ["spawn", "ProcessContext", "ProcessRaisedException",
+           "ProcessExitedException"]

@@ -1,0 +1,106 @@
+"""``python -m tpu_dist.launch`` — the torch.distributed.launch CLI (L5).
+
+The reference's second launch mode (/root/reference/README.md:341-343)::
+
+    python -m torch.distributed.launch --nproc_per_node=1 --nnodes=2
+        --node_rank=0 --master_addr='...' --master_port=22222 launch_dist.py
+
+This CLI reproduces the exact env contract consumed at
+/root/reference/launch_dist.py:45-46 and example_launch.py:17-18: each child
+gets ``RANK``, ``LOCAL_RANK``, ``WORLD_SIZE``, ``MASTER_ADDR``,
+``MASTER_PORT`` (plus ``LOCAL_WORLD_SIZE``/``NODE_RANK``), then the script
+calls ``init_process_group(init_method='env://')``.
+
+TPU deployment note: on a pod slice run ONE launch per host with
+``--nproc_per_node=1`` (the process drives all local cores); ``WORLD_SIZE``
+then equals nnodes, and the in-process device world is
+``dist.get_world_size()`` (cores).  ``--nproc_per_node>1`` is for the CPU
+backend (teaching/testing parity with the reference's one-process-per-GPU).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+from typing import List, Optional
+
+__all__ = ["main", "build_parser"]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tpu_dist.launch",
+        description="Launch a script across processes/nodes with the "
+                    "RANK/LOCAL_RANK/WORLD_SIZE/MASTER_ADDR/MASTER_PORT "
+                    "env contract (torch.distributed.launch parity).")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="processes on this node (TPU: keep 1 per host)")
+    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--node_rank", type=int, default=0)
+    p.add_argument("--master_addr", type=str, default="127.0.0.1")
+    p.add_argument("--master_port", type=int, default=29500)
+    p.add_argument("--module", "-m", action="store_true",
+                   help="treat script as a python module (python -m ...)")
+    p.add_argument("script", type=str)
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.node_rank >= args.nnodes or args.node_rank < 0:
+        sys.stderr.write(f"--node_rank {args.node_rank} out of range for "
+                         f"--nnodes {args.nnodes}\n")
+        return 2
+    world_size = args.nproc_per_node * args.nnodes
+
+    procs: List[subprocess.Popen] = []
+    for local_rank in range(args.nproc_per_node):
+        rank = args.node_rank * args.nproc_per_node + local_rank
+        env = dict(os.environ,
+                   RANK=str(rank),
+                   LOCAL_RANK=str(local_rank),
+                   WORLD_SIZE=str(world_size),
+                   LOCAL_WORLD_SIZE=str(args.nproc_per_node),
+                   NODE_RANK=str(args.node_rank),
+                   MASTER_ADDR=args.master_addr,
+                   MASTER_PORT=str(args.master_port))
+        cmd = [sys.executable]
+        if args.module:
+            cmd += ["-m", args.script]
+        else:
+            cmd += [args.script]
+        cmd += args.script_args
+        procs.append(subprocess.Popen(cmd, env=env))
+
+    # Fail fast: first non-zero exit kills the rest (mp.spawn-style semantics
+    # the reference depends on; torch.distributed.launch exits similarly).
+    exit_code = 0
+    try:
+        remaining = set(range(len(procs)))
+        while remaining:
+            for i in list(remaining):
+                rc = procs[i].poll()
+                if rc is None:
+                    continue
+                remaining.discard(i)
+                if rc != 0 and exit_code == 0:
+                    exit_code = rc
+                    for j in remaining:
+                        procs[j].terminate()
+            if remaining:
+                try:
+                    procs[next(iter(remaining))].wait(timeout=0.2)
+                except subprocess.TimeoutExpired:
+                    pass
+    except KeyboardInterrupt:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGINT)
+        for p in procs:
+            p.wait()
+        exit_code = 130
+    return exit_code

@@ -1,0 +1,127 @@
+"""Process spawner — ``torch.multiprocessing.spawn`` parity (L5).
+
+The reference brings up one worker process per GPU with
+``mp.spawn(train, nprocs=args.gpus, args=(args,))``
+(/root/reference/mpspawn_dist.py:140, example_mp.py:27): fork N children,
+call ``fn(local_rank, *args)`` in each, propagate the first child exception
+and terminate the siblings.
+
+TPU caveat (by design, not limitation): a TPU chip's cores belong to ONE
+process — the idiomatic bring-up is one process per *host* driving all local
+cores via the mesh (no spawn at all; see examples/).  ``spawn`` exists for
+
+- the reference's teaching scenario on the CPU backend (N processes × 1
+  virtual device), and
+- per-host process management on multi-host slices (spawning *one* worker
+  per host under a cluster scheduler).
+
+Children should set ``JAX_PLATFORMS``/backend themselves before importing
+jax (the parent's initialized runtime is never inherited — ``spawn`` start
+method, never fork: a forked XLA runtime deadlocks).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import sys
+import traceback
+from typing import Optional, Sequence, Tuple
+
+__all__ = ["spawn", "ProcessContext", "ProcessRaisedException",
+           "ProcessExitedException"]
+
+
+class ProcessRaisedException(Exception):
+    """A child raised; carries the child's formatted traceback
+    (torch.multiprocessing.ProcessRaisedException parity)."""
+
+    def __init__(self, msg: str, error_index: int, pid: Optional[int]):
+        super().__init__(msg)
+        self.error_index = error_index
+        self.pid = pid
+
+
+class ProcessExitedException(Exception):
+    """A child exited abnormally without raising (signal / sys.exit != 0)."""
+
+    def __init__(self, msg: str, error_index: int, exit_code: Optional[int]):
+        super().__init__(msg)
+        self.error_index = error_index
+        self.exit_code = exit_code
+
+
+def _wrap(fn, i, args, error_queue):
+    try:
+        fn(i, *args)
+    except KeyboardInterrupt:
+        pass  # parent handles
+    except Exception:
+        error_queue.put((i, traceback.format_exc()))
+        sys.exit(1)
+
+
+class ProcessContext:
+    def __init__(self, processes, error_queue):
+        self.processes = processes
+        self.error_queue = error_queue
+
+    def pids(self):
+        return [p.pid for p in self.processes]
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        """Join all children; on any failure, terminate the rest and raise
+        (the fail-fast the reference relies on — SURVEY.md §5 failure
+        detection row).  Returns True when all exited cleanly, False when
+        ``timeout`` elapsed with children still running."""
+        import time
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            alive = [p for p in self.processes if p.is_alive()]
+            failed = [(i, p) for i, p in enumerate(self.processes)
+                      if not p.is_alive() and p.exitcode != 0]
+            if failed:
+                idx, proc = failed[0]
+                for p in alive:
+                    p.terminate()
+                for p in self.processes:
+                    p.join()
+                if not self.error_queue.empty():
+                    i, tb = self.error_queue.get()
+                    raise ProcessRaisedException(
+                        f"\n-- Process {i} terminated with the following "
+                        f"error:\n{tb}", i, proc.pid)
+                raise ProcessExitedException(
+                    f"process {idx} terminated with exit code {proc.exitcode}",
+                    idx, proc.exitcode)
+            if not alive:
+                return True
+            alive[0].join(timeout=0.25)
+
+
+def spawn(fn, args: Tuple = (), nprocs: int = 1, join: bool = True,
+          daemon: bool = False, start_method: str = "spawn"):
+    """Spawn ``nprocs`` processes running ``fn(i, *args)``.
+
+    Matches the torch API (/root/reference/mpspawn_dist.py:140).  ``fn`` must
+    be picklable (module-level).  With ``join=True`` blocks until all
+    children finish, raising on the first failure; otherwise returns a
+    :class:`ProcessContext`.
+    """
+    if nprocs < 1:
+        raise ValueError(f"nprocs must be >= 1, got {nprocs}")
+    ctx = mp.get_context(start_method)
+    error_queue = ctx.SimpleQueue()
+    processes = []
+    for i in range(nprocs):
+        p = ctx.Process(target=_wrap, args=(fn, i, args, error_queue),
+                        daemon=daemon)
+        p.start()
+        processes.append(p)
+    pc = ProcessContext(processes, error_queue)
+    if join:
+        pc.join()
+        return None
+    return pc

@@ -1,0 +1,14 @@
+"""tpu_dist.parallel — parallelism wrappers (L3 of SURVEY.md §1).
+
+Data parallelism is the reference's only strategy (SURVEY.md §2c); the mesh
+design leaves room for tp/pp/sp axes (ProcessGroup accepts custom
+axis_names/mesh_shape)."""
+
+from .ddp import (DistributedDataParallel, TrainState,
+                  convert_sync_batchnorm)
+
+# torch-style alias (the reference imports nn.parallel.DistributedDataParallel)
+DDP = DistributedDataParallel
+
+__all__ = ["DistributedDataParallel", "DDP", "TrainState",
+           "convert_sync_batchnorm"]

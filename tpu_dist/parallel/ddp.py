@@ -1,0 +1,226 @@
+"""DistributedDataParallel — the L3 wrapper, compiled instead of hooked.
+
+torch's DDP (`/root/reference/mpspawn_dist.py:68`, `example_mp.py:53`) is a
+*runtime object*: it broadcasts parameters from rank 0 at wrap time, then
+hooks autograd to fire bucketed NCCL all-reduces overlapped with backward.
+
+On TPU none of that machinery exists at runtime — it is **compiled in**
+(SURVEY.md §7 design stance; BASELINE.json north star: "fwd/bwd + gradient
+all-reduce in a single XLA graph").  This wrapper builds ONE jitted step:
+
+    forward → loss → pmean(loss) over the data axis → grad → SGD update
+
+under ``shard_map`` over the group's mesh.  Two properties make the gradient
+all-reduce both correct and free:
+
+- **JAX 0.9 VMA autodiff**: inside ``shard_map``, parameters enter replicated
+  (``P()`` in_spec).  Differentiating w.r.t. a replicated value auto-inserts
+  the ``psum`` of per-device cotangents.  Taking the gradient *of the
+  pmean-ed loss* therefore yields exactly the DDP-averaged gradient — adding
+  an explicit ``pmean`` on grads afterwards would double-count (verified the
+  hard way; see .claude/skills/verify/SKILL.md).
+- **XLA fusion/scheduling**: the all-reduce is an op in the backward graph,
+  so XLA overlaps it with remaining backward compute on ICI — the same
+  overlap DDP's Reducer implements by hand with buckets and streams.
+
+BatchNorm semantics (SURVEY.md §2b #16): batch statistics stay **per-replica**
+(DDP parity — torch DDP does not sync BN).  Running-stat *updates* are
+pmean-ed across replicas to keep the state replicated; this is a documented,
+deliberate improvement over torch's keep-rank-0's-stats (identical in
+distribution, strictly less variance).  ``sync_batchnorm=True`` converts BN
+layers to cross-replica batch stats (torch SyncBatchNorm parity).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..nn.layers import BatchNorm2d
+from ..nn.module import Module
+
+__all__ = ["TrainState", "DistributedDataParallel", "convert_sync_batchnorm"]
+
+
+class TrainState(NamedTuple):
+    """Replicated training state threaded through the jitted step."""
+    params: Any
+    model_state: Any      # BN running stats etc.; {} for stateless nets
+    opt_state: Any
+    step: jnp.ndarray     # scalar int32
+    rng: jnp.ndarray      # base PRNG key; per-step/per-replica keys derive
+
+
+def convert_sync_batchnorm(module: Module, axis_name: str) -> Module:
+    """Set every BatchNorm layer to compute cross-replica batch statistics
+    (torch ``SyncBatchNorm.convert_sync_batchnorm`` parity).  Mutates and
+    returns the module (topology objects hold no arrays, so this is safe
+    before ``init``/``apply``)."""
+    for _, m in module.named_modules():
+        if isinstance(m, BatchNorm2d):
+            m.axis_name = axis_name
+    return module
+
+
+class DistributedDataParallel:
+    """Data-parallel training driver over a process group's mesh.
+
+    Usage (the reference loop shape, /root/reference/mpspawn_dist.py:97-118)::
+
+        pg = dist.init_process_group()
+        ddp = DistributedDataParallel(model, optimizer=SGD(lr),
+                                      loss_fn=nn.CrossEntropyLoss(), group=pg)
+        state = ddp.init(seed=0)        # == manual_seed(0) on every rank
+        for epoch in range(E):
+            loader.set_epoch(epoch)
+            for xb, yb in device_loader:
+                state, metrics = ddp.train_step(state, xb, yb)
+
+    ``metrics`` holds ``loss`` (global mean) and ``correct`` (global count),
+    as on-device scalars — don't block on them every step (SURVEY.md §7:
+    ``loss.item()`` per step kills pipelining; log every N).
+    """
+
+    def __init__(self, module: Module, optimizer=None, loss_fn=None,
+                 group=None, sync_batchnorm: bool = False,
+                 donate: bool = True):
+        if group is None:
+            from .. import dist as _dist
+            group = _dist.get_default_group()
+        self.module = module
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.group = group
+        self.axis = group.axis_name
+        self.donate = donate
+        if sync_batchnorm:
+            convert_sync_batchnorm(module, self.axis)
+        self._train_step = None
+        self._eval_step = None
+        self._forward = None
+
+    # -- state ----------------------------------------------------------------
+    def init(self, seed: int = 0, rng: Optional[jax.Array] = None) -> TrainState:
+        """Build replicated TrainState.
+
+        Deterministic given ``seed`` — every process constructs identical
+        parameters, the TPU analogue of ``torch.manual_seed(0)`` before DDP
+        wrap (/root/reference/mpspawn_dist.py:56).  (DDP's alternative —
+        rank-0 broadcast at wrap time, /root/reference/example_mp.py:53 —
+        is unnecessary when init is deterministic, but available as
+        ``collectives.broadcast_host`` for externally-loaded params.)
+        """
+        key = rng if rng is not None else jax.random.key(seed)
+        params = self.module.init(key)
+        model_state = self.module.init_state()
+        opt_state = (self.optimizer.init(params)
+                     if self.optimizer is not None else {})
+        state = TrainState(params, model_state, opt_state,
+                           jnp.zeros((), jnp.int32),
+                           jax.random.key_data(jax.random.fold_in(key, 0x5eed)))
+        # commit replicated onto the mesh so donation reuses buffers
+        repl = NamedSharding(self.group.mesh, P())
+        return jax.tree.map(lambda a: jax.device_put(a, repl), state)
+
+    # -- compiled steps --------------------------------------------------------
+    def _build_train_step(self):
+        module, loss_fn, optimizer, axis = (self.module, self.loss_fn,
+                                            self.optimizer, self.axis)
+        has_state = module.has_state()
+
+        def local_step(state: TrainState, x, y):
+            params, mstate, opt_state, step, rng_data = state
+            # per-step, per-replica key (dropout/augment must differ by rank
+            # — SURVEY.md §7 per-replica RNG)
+            key = jax.random.wrap_key_data(rng_data)
+            key = jax.random.fold_in(jax.random.fold_in(key, step),
+                                     lax.axis_index(axis))
+
+            def loss_local(p):
+                if has_state:
+                    out, new_ms = module.apply(p, x, state=mstate,
+                                               training=True, rng=key)
+                else:
+                    out = module.apply(p, x, training=True, rng=key)
+                    new_ms = mstate
+                loss = loss_fn(out, y)
+                # global mean; grad w.r.t. replicated p then carries the
+                # automatic psum of cotangents = DDP-averaged gradient
+                return lax.pmean(loss, axis), (out, new_ms)
+
+            (loss, (out, new_ms)), grads = jax.value_and_grad(
+                loss_local, has_aux=True)(params)
+            new_params, new_opt = optimizer.update(grads, opt_state, params)
+            if has_state:
+                # keep replicated-state invariant: average the per-replica
+                # running-stat updates (see module docstring)
+                new_ms = jax.tree.map(lambda v: lax.pmean(v, axis), new_ms)
+            correct = lax.psum((out.argmax(-1) == y).sum(), axis)
+            new_state = TrainState(new_params, new_ms, new_opt, step + 1,
+                                   rng_data)
+            return new_state, {"loss": loss, "correct": correct}
+
+        mesh = self.group.mesh
+        state_spec = P()  # fully replicated
+        fn = jax.shard_map(local_step, mesh=mesh,
+                           in_specs=(state_spec, P(axis), P(axis)),
+                           out_specs=(state_spec, state_spec))
+        return jax.jit(fn, donate_argnums=(0,) if self.donate else ())
+
+    def _build_eval_step(self):
+        module, loss_fn, axis = self.module, self.loss_fn, self.axis
+        has_state = module.has_state()
+
+        def local_eval(state: TrainState, x, y):
+            out = module.apply(state.params, x,
+                               **({"state": state.model_state} if has_state
+                                  else {}))
+            if has_state:
+                out, _ = out
+            loss = lax.pmean(loss_fn(out, y), axis)
+            correct = lax.psum((out.argmax(-1) == y).sum(), axis)
+            return {"loss": loss, "correct": correct}
+
+        fn = jax.shard_map(local_eval, mesh=self.group.mesh,
+                           in_specs=(P(), P(axis), P(axis)),
+                           out_specs=P())
+        return jax.jit(fn)
+
+    # -- public API ------------------------------------------------------------
+    def train_step(self, state: TrainState, x, y):
+        """One fused fwd+bwd+allreduce+update step; returns
+        ``(new_state, {"loss": scalar, "correct": count})``."""
+        if self.optimizer is None or self.loss_fn is None:
+            raise ValueError("train_step requires optimizer= and loss_fn=")
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+        return self._train_step(state, x, y)
+
+    def eval_step(self, state: TrainState, x, y):
+        if self.loss_fn is None:
+            raise ValueError("eval_step requires loss_fn=")
+        if self._eval_step is None:
+            self._eval_step = self._build_eval_step()
+        return self._eval_step(state, x, y)
+
+    def forward(self, state: TrainState, x):
+        """Inference forward on a (data-axis-sharded) batch; returns logits
+        sharded the same way (torch ``ddp_model(images)`` parity)."""
+        if self._forward is None:
+            module, has_state = self.module, self.module.has_state()
+
+            def local_fwd(params, mstate, xx):
+                out = module.apply(params, xx,
+                                   **({"state": mstate} if has_state else {}))
+                return out[0] if has_state else out
+
+            fn = jax.shard_map(local_fwd, mesh=self.group.mesh,
+                               in_specs=(P(), P(), P(self.axis)),
+                               out_specs=P(self.axis))
+            self._forward = jax.jit(fn)
+        return self._forward(state.params, state.model_state, x)
