@@ -1,0 +1,118 @@
+"""Checkpoint save/restore (SURVEY.md §5 extension)."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import tpu_dist.dist as dist
+from tpu_dist import checkpoint, nn, optim
+from tpu_dist.models import ConvNet
+from tpu_dist.parallel import DDP
+
+
+@pytest.fixture
+def pg():
+    if dist.is_initialized():
+        dist.destroy_process_group()
+    pg = dist.init_process_group()
+    yield pg
+    if dist.is_initialized():
+        dist.destroy_process_group()
+
+
+def test_roundtrip_trainstate(tmp_path, pg):
+    ddp = DDP(ConvNet(), optimizer=optim.SGD(lr=0.1, momentum=0.9),
+              loss_fn=nn.CrossEntropyLoss(), group=pg, donate=False)
+    state = ddp.init(seed=0)
+    rng = np.random.default_rng(0)
+    x = np.asarray(rng.normal(size=(16, 28, 28, 1)), np.float32)
+    y = rng.integers(0, 10, 16)
+    state, _ = ddp.train_step(state, x, y)
+
+    path = checkpoint.save(str(tmp_path), state, step=1,
+                           metadata={"note": "after one step"})
+    assert os.path.isdir(path)
+    restored = checkpoint.restore(str(tmp_path), state)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), state, restored)
+
+    # resume training from restored state must continue identically
+    s_a, m_a = ddp.train_step(state, x, y)
+    s_b, m_b = ddp.train_step(restored, x, y)
+    np.testing.assert_allclose(float(m_a["loss"]), float(m_b["loss"]),
+                               rtol=1e-6)
+
+
+def test_latest_and_keep(tmp_path):
+    tree = {"w": np.arange(4.0)}
+    for s in (1, 5, 3):
+        checkpoint.save(str(tmp_path), tree, step=s)
+    assert checkpoint.all_steps(str(tmp_path)) == [1, 3, 5]
+    assert checkpoint.latest_step(str(tmp_path)) == 5
+    checkpoint.save(str(tmp_path), tree, step=7, keep=2)
+    assert checkpoint.all_steps(str(tmp_path)) == [5, 7]
+
+
+def test_restore_specific_step(tmp_path):
+    for s in (1, 2):
+        checkpoint.save(str(tmp_path), {"w": np.full(3, float(s))}, step=s)
+    out = checkpoint.restore(str(tmp_path), {"w": np.zeros(3)}, step=1)
+    np.testing.assert_array_equal(out["w"], np.ones(3))
+
+
+def test_empty_root_raises(tmp_path):
+    with pytest.raises(FileNotFoundError, match="no checkpoints"):
+        checkpoint.restore(str(tmp_path / "none"), {"w": np.zeros(2)})
+
+
+def test_structure_mismatch_raises(tmp_path):
+    checkpoint.save(str(tmp_path), {"w": np.zeros(3)}, step=1)
+    with pytest.raises(ValueError, match="does not match template"):
+        checkpoint.restore(str(tmp_path), {"w": np.zeros(3),
+                                           "b": np.zeros(1)})
+
+
+def test_shape_mismatch_raises(tmp_path):
+    checkpoint.save(str(tmp_path), {"w": np.zeros(3)}, step=1)
+    with pytest.raises(ValueError, match="shape"):
+        checkpoint.restore(str(tmp_path), {"w": np.zeros(4)})
+
+
+def test_metadata_written(tmp_path):
+    import json
+    p = checkpoint.save(str(tmp_path), {"w": np.zeros(1)}, step=9,
+                        metadata={"epoch": 3})
+    with open(os.path.join(p, "tree.json")) as f:
+        meta = json.load(f)
+    assert meta["metadata"] == {"epoch": 3}
+    assert meta["step"] == 9
+
+
+def test_dtype_mismatch_raises(tmp_path):
+    checkpoint.save(str(tmp_path), {"w": np.zeros(3, np.float32)}, step=1)
+    with pytest.raises(ValueError, match="dtype"):
+        checkpoint.restore(str(tmp_path), {"w": np.zeros(3, np.int32)})
+
+
+def test_sharding_pytree(tmp_path, pg):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    tree = {"w": np.arange(8.0), "b": np.arange(4.0)}
+    checkpoint.save(str(tmp_path), tree, step=0)
+    repl = NamedSharding(pg.mesh, P())
+    row = NamedSharding(pg.mesh, P("data"))
+    out = checkpoint.restore(str(tmp_path), tree,
+                             sharding={"w": row, "b": repl})
+    assert out["w"].sharding == row and out["b"].sharding == repl
+    np.testing.assert_array_equal(np.asarray(out["w"]), tree["w"])
+
+
+def test_sharded_restore(tmp_path, pg):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    tree = {"w": np.arange(8.0)}
+    checkpoint.save(str(tmp_path), tree, step=0)
+    sh = NamedSharding(pg.mesh, P())
+    out = checkpoint.restore(str(tmp_path), tree, sharding=sh)
+    assert out["w"].sharding == sh
+    np.testing.assert_array_equal(np.asarray(out["w"]), tree["w"])

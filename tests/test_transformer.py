@@ -1,0 +1,90 @@
+"""TransformerLM: dense vs sequence-parallel equality + trainability."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_dist import nn, optim
+from tpu_dist.models import TransformerLM
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return Mesh(np.array(devs[:8]), ("seq",))
+
+
+def _tokens(b=2, t=64, vocab=50, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.integers(0, vocab, (b, t)))
+
+
+class TestForward:
+    def test_shapes(self):
+        model = TransformerLM(vocab_size=50, dim=32, depth=2, num_heads=4,
+                              max_seq_len=128)
+        params = model.init(jax.random.key(0))
+        out = model.apply(params, _tokens())
+        assert out.shape == (2, 64, 50)
+
+    @pytest.mark.parametrize("mode", ["ring", "ulysses"])
+    def test_sequence_parallel_matches_dense(self, mesh, mode):
+        """Same params, same tokens: seq-sharded model == dense model."""
+        kwargs = dict(vocab_size=50, dim=32, depth=2, num_heads=8,
+                      max_seq_len=128)
+        dense = TransformerLM(**kwargs)
+        sharded = TransformerLM(**kwargs, sequence_axis="seq", mode=mode)
+        params = dense.init(jax.random.key(0))
+        idx = _tokens()
+        ref = dense.apply(params, idx)
+
+        def fwd(params, idx):
+            # pos_offset derives automatically from the seq axis index
+            return sharded.apply(params, idx)
+
+        pspec = jax.tree.map(lambda _: P(), params)
+        out = jax.jit(jax.shard_map(
+            fwd, mesh=mesh, in_specs=(pspec, P(None, "seq")),
+            out_specs=P(None, "seq")))(params, idx)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=5e-4, atol=5e-5)
+
+
+class TestTraining:
+    def test_loss_decreases(self):
+        model = TransformerLM(vocab_size=32, dim=32, depth=1, num_heads=2,
+                              max_seq_len=64)
+        params = model.init(jax.random.key(0))
+        opt = optim.SGD(lr=0.5)
+        opt_state = opt.init(params)
+        loss_fn = nn.CrossEntropyLoss()
+        # next-token prediction on a fixed periodic sequence
+        seq = jnp.asarray((np.arange(33) * 7) % 32)[None, :]
+        x, y = seq[:, :-1], seq[:, 1:]
+
+        @jax.jit
+        def step(p, s):
+            def l(pp):
+                logits = model.apply(pp, x)
+                return loss_fn(logits.reshape(-1, 32), y.reshape(-1))
+            loss, g = jax.value_and_grad(l)(p)
+            p, s = opt.update(g, s, p)
+            return p, s, loss
+
+        first = None
+        for _ in range(30):
+            params, opt_state, loss = step(params, opt_state)
+            if first is None:
+                first = float(loss)
+        assert float(loss) < first / 2
+
+    def test_position_bound(self):
+        model = TransformerLM(vocab_size=8, dim=16, depth=1, num_heads=2,
+                              max_seq_len=16)
+        params = model.init(jax.random.key(0))
+        out = model.apply(params, _tokens(b=1, t=16, vocab=8))
+        assert out.shape == (1, 16, 8)

@@ -1,0 +1,51 @@
+"""utils: metric logger windows + step timer."""
+
+import numpy as np
+
+from tpu_dist.utils import MetricLogger, StepTimer
+
+
+class TestMetricLogger:
+    def test_window_average(self, capsys):
+        log = MetricLogger(every=3, fmt="s{step} loss={loss:.2f}")
+        out = None
+        for i in range(6):
+            out = log.push(step=i + 1, loss=float(i))
+        # windows: [0,1,2] -> 1.0 at step 3; [3,4,5] -> 4.0 at step 6
+        assert out == {"loss": 4.0}
+        printed = capsys.readouterr().out
+        assert "s3 loss=1.00" in printed and "s6 loss=4.00" in printed
+
+    def test_ratio_pairs(self):
+        log = MetricLogger(every=2)
+        log.push(step=1, acc=(3, 10))
+        out = log.push(step=2, acc=(7, 10))
+        assert out == {"acc": 0.5}
+
+    def test_incomplete_window_returns_none(self):
+        log = MetricLogger(every=5)
+        assert log.push(step=1, loss=1.0) is None
+
+    def test_device_scalars(self):
+        import jax.numpy as jnp
+        log = MetricLogger(every=2)
+        log.push(step=1, loss=jnp.asarray(2.0))
+        out = log.push(step=2, loss=jnp.asarray(4.0))
+        assert out == {"loss": 3.0}
+
+
+class TestStepTimer:
+    def test_warmup_excluded_and_stats(self):
+        t = StepTimer(warmup=2)
+        import time
+        for i in range(6):
+            with t:
+                time.sleep(0.001)
+        assert t.steps == 4
+        assert t.mean() > 0
+        assert t.percentile(50) <= t.percentile(95) or t.steps < 2
+        assert "steps=4" in t.summary()
+
+    def test_empty(self):
+        t = StepTimer()
+        assert t.mean() == 0.0 and t.percentile(50) == 0.0

@@ -11,11 +11,14 @@ TPU.  Currently shipped subpackages:
 - ``tpu_dist.collectives`` — in-jit (psum/ring) + eager collectives
 - ``tpu_dist.data`` — samplers, datasets, transforms, device prefetch
 - ``tpu_dist.parallel`` — DistributedDataParallel (fused-psum train step)
+- ``tpu_dist.checkpoint`` — atomic step-numbered save/restore
+- ``tpu_dist.utils`` — rank-0 logging, metric windows, profiling
 """
 
 __version__ = "0.1.0"
 
-from . import collectives, data, dist, models, nn, optim, parallel
+from . import (checkpoint, collectives, data, dist, models, nn, optim,
+               parallel, utils)
 
 __all__ = ["nn", "optim", "models", "dist", "collectives", "data",
-           "parallel", "__version__"]
+           "parallel", "checkpoint", "utils", "__version__"]

@@ -2,5 +2,7 @@
 
 from .convnet import ConvNet
 from .resnet import ResNet, resnet18, resnet34, resnet50
+from .transformer import TransformerBlock, TransformerLM
 
-__all__ = ["ConvNet", "ResNet", "resnet18", "resnet34", "resnet50"]
+__all__ = ["ConvNet", "ResNet", "resnet18", "resnet34", "resnet50",
+           "TransformerLM", "TransformerBlock"]

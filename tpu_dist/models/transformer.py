@@ -1,0 +1,82 @@
+"""Decoder-only Transformer LM — the long-context workload.
+
+No counterpart in the reference (its workloads are image classifiers,
+SURVEY.md §2a); this model exists because tpu_dist treats sequence
+parallelism as first-class: with ``sequence_axis`` set, every attention
+layer runs ring (or Ulysses) attention over the mesh's sequence axis and
+the same model trains on contexts far beyond one core's memory.
+
+Architecture: pre-LN blocks (LN → MHSA → residual, LN → MLP(4x, GELU) →
+residual), learned positional embeddings, weight-untied LM head.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .. import nn
+
+__all__ = ["TransformerLM", "TransformerBlock"]
+
+
+class TransformerBlock(nn.Module):
+    def __init__(self, dim: int, num_heads: int, causal: bool = True,
+                 sequence_axis: Optional[str] = None, mode: str = "ring"):
+        super().__init__()
+        self.ln1 = nn.LayerNorm(dim)
+        self.attn = nn.MultiheadSelfAttention(dim, num_heads, causal=causal,
+                                              sequence_axis=sequence_axis,
+                                              mode=mode)
+        self.ln2 = nn.LayerNorm(dim)
+        self.mlp = nn.Sequential(nn.Linear(dim, 4 * dim), nn.GELU(),
+                                 nn.Linear(4 * dim, dim))
+
+    def forward(self, x):
+        x = x + self.attn(self.ln1(x))
+        x = x + self.mlp(self.ln2(x))
+        return x
+
+
+class TransformerLM(nn.Module):
+    """Causal LM: tokens (B, T) → logits (B, T, vocab).
+
+    ``sequence_axis``: mesh axis name for sequence parallelism.  Embeddings
+    are computed on the local sequence shard; the shard's global position
+    offset is derived **automatically** from ``lax.axis_index(sequence_axis)``
+    when tracing inside ``shard_map`` — callers never plumb it.  Pass
+    ``pos_offset`` only to override (e.g. sliding-window training on
+    unsharded models).
+    """
+
+    def __init__(self, vocab_size: int, dim: int = 128, depth: int = 2,
+                 num_heads: int = 4, max_seq_len: int = 1024,
+                 causal: bool = True, sequence_axis: Optional[str] = None,
+                 mode: str = "ring"):
+        super().__init__()
+        self.vocab_size = vocab_size
+        self.max_seq_len = max_seq_len
+        self.tok = nn.Embedding(vocab_size, dim)
+        self.pos = nn.Embedding(max_seq_len, dim)
+        for i in range(depth):
+            setattr(self, f"block{i}", TransformerBlock(
+                dim, num_heads, causal=causal,
+                sequence_axis=sequence_axis, mode=mode))
+        self.depth = depth
+        self.sequence_axis = sequence_axis
+        self.ln_f = nn.LayerNorm(dim)
+        self.head = nn.Linear(dim, vocab_size)
+
+    def forward(self, idx, pos_offset=None):
+        t = idx.shape[1]
+        if pos_offset is None:
+            if self.sequence_axis is not None:
+                from jax import lax
+                pos_offset = lax.axis_index(self.sequence_axis) * t
+            else:
+                pos_offset = 0
+        x = self.tok(idx) + self.pos(pos_offset + jnp.arange(t))
+        for i in range(self.depth):
+            x = getattr(self, f"block{i}")(x)
+        return self.head(self.ln_f(x))

@@ -2,8 +2,10 @@
 SURVEY.md §1)."""
 
 from . import functional, init
+from .attention import MultiheadSelfAttention, scaled_dot_product_attention
 from .layers import (AdaptiveAvgPool2d, AvgPool2d, BatchNorm2d, Conv2d,
-                     Dropout, Flatten, Identity, Linear, MaxPool2d, ReLU)
+                     Dropout, Embedding, Flatten, GELU, Identity, LayerNorm,
+                     Linear, MaxPool2d, ReLU)
 from .loss import CrossEntropyLoss
 from .module import Module, Sequential
 
@@ -11,5 +13,7 @@ __all__ = [
     "Module", "Sequential", "functional", "init",
     "Linear", "Conv2d", "MaxPool2d", "AvgPool2d", "AdaptiveAvgPool2d",
     "ReLU", "Flatten", "Dropout", "BatchNorm2d", "Identity",
+    "Embedding", "LayerNorm", "GELU",
+    "MultiheadSelfAttention", "scaled_dot_product_attention",
     "CrossEntropyLoss",
 ]

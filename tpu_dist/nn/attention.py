@@ -1,0 +1,111 @@
+"""Attention layers — the sequence-model substrate.
+
+The reference has no attention (workloads are 28²/32² image classifiers,
+SURVEY.md §5 long-context row: absent).  tpu_dist treats long-context as
+first-class: these layers run dense single-device attention by default and
+switch to **sequence-parallel** execution (ring attention or Ulysses
+all-to-all, tpu_dist.parallel.ring_attention) when given a mesh axis, so the
+same model scales from one chip to a pod slice with a constructor argument.
+
+Functional core: :func:`scaled_dot_product_attention` (flash-style math is
+XLA's job on TPU — it fuses and tiles the softmax; the explicitly blocked
+variants live in the parallel package where the blocking crosses devices).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from . import functional as F
+from .module import Module
+from . import init as I
+
+__all__ = ["scaled_dot_product_attention", "MultiheadSelfAttention"]
+
+
+def scaled_dot_product_attention(q, k, v, causal: bool = False,
+                                 mask: Optional[jax.Array] = None):
+    """Dense attention.  ``q,k,v``: (..., T, H, D) → (..., T, H, D).
+
+    ``mask``: broadcastable to (..., H, Tq, Tk), True = keep.
+    """
+    d = q.shape[-1]
+    # (..., H, Tq, Tk)
+    scores = jnp.einsum("...qhd,...khd->...hqk", q, k) / math.sqrt(d)
+    if causal:
+        tq, tk = scores.shape[-2], scores.shape[-1]
+        qpos = jnp.arange(tq)[:, None]
+        kpos = jnp.arange(tk)[None, :]
+        scores = jnp.where(kpos <= qpos, scores, -jnp.inf)
+    if mask is not None:
+        scores = jnp.where(mask, scores, -jnp.inf)
+    w = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("...hqk,...khd->...qhd", w, v)
+
+
+class MultiheadSelfAttention(Module):
+    """Multi-head self-attention with fused QKV projection.
+
+    ``sequence_axis``: when set (e.g. ``'seq'``) and traced inside
+    ``shard_map`` over that mesh axis, the layer computes sequence-parallel
+    attention — ``mode='ring'`` rotates KV blocks around the ring
+    (ring attention), ``mode='ulysses'`` redistributes heads via all-to-all.
+    Results equal the dense computation (tested in tests/test_ring_attention.py).
+    """
+
+    def __init__(self, embed_dim: int, num_heads: int, bias: bool = True,
+                 causal: bool = False, sequence_axis: Optional[str] = None,
+                 mode: str = "ring"):
+        super().__init__()
+        if embed_dim % num_heads:
+            raise ValueError(f"embed_dim {embed_dim} not divisible by "
+                             f"num_heads {num_heads}")
+        if mode not in ("ring", "ulysses"):
+            raise ValueError(f"Unknown sequence-parallel mode {mode!r}")
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.bias = bias
+        self.causal = causal
+        self.sequence_axis = sequence_axis
+        self.mode = mode
+
+    def create_params(self, key):
+        k1, k2 = jax.random.split(key)
+        p = {"qkv_weight": I.torch_default_uniform(
+                 k1, (self.embed_dim, 3 * self.embed_dim), self.embed_dim),
+             "out_weight": I.torch_default_uniform(
+                 k2, (self.embed_dim, self.embed_dim), self.embed_dim)}
+        if self.bias:
+            p["qkv_bias"] = jnp.zeros((3 * self.embed_dim,))
+            p["out_bias"] = jnp.zeros((self.embed_dim,))
+        return p
+
+    def forward(self, x):
+        from .module import _ctx
+        p = _ctx().get_params(self._path)
+        b, t, _ = x.shape
+        qkv = F.linear(x, p["qkv_weight"], p.get("qkv_bias"))
+        qkv = qkv.reshape(b, t, 3, self.num_heads, self.head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if self.sequence_axis is not None:
+            from ..parallel.ring_attention import (ring_self_attention,
+                                                   ulysses_self_attention)
+            fn = (ring_self_attention if self.mode == "ring"
+                  else ulysses_self_attention)
+            out = fn(q, k, v, axis_name=self.sequence_axis,
+                     causal=self.causal)
+        else:
+            out = scaled_dot_product_attention(q, k, v, causal=self.causal)
+        out = out.reshape(b, t, self.embed_dim)
+        return F.linear(out, p["out_weight"], p.get("out_bias"))
+
+    def __repr__(self):
+        sp = (f", sequence_axis={self.sequence_axis!r}, mode={self.mode!r}"
+              if self.sequence_axis else "")
+        return (f"MultiheadSelfAttention({self.embed_dim}, "
+                f"heads={self.num_heads}, causal={self.causal}{sp})")

@@ -22,6 +22,7 @@ from .module import Module, _ctx
 __all__ = [
     "Linear", "Conv2d", "MaxPool2d", "AvgPool2d", "AdaptiveAvgPool2d",
     "ReLU", "Flatten", "Dropout", "BatchNorm2d", "Identity",
+    "Embedding", "LayerNorm", "GELU",
 ]
 
 _IntOr2 = Union[int, Tuple[int, int]]
@@ -243,3 +244,72 @@ class BatchNorm2d(Module):
 
     def __repr__(self):
         return f"BatchNorm2d({self.num_features})"
+
+
+class Embedding(Module):
+    """Token embedding lookup (torch ``nn.Embedding`` parity; N(0,1) init).
+
+    Divergence from torch: out-of-range indices are CLAMPED to the last row
+    (XLA gather semantics under jit — no device-side bounds trap exists on
+    TPU), where torch raises IndexError.  Validate token ids host-side when
+    the vocabulary mapping is untrusted.
+    """
+
+    def __init__(self, num_embeddings: int, embedding_dim: int):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+
+    def create_params(self, key):
+        return {"weight": init_lib.normal(
+            key, (self.num_embeddings, self.embedding_dim), std=1.0)}
+
+    def forward(self, idx):
+        w = _ctx().get_params(self._path)["weight"]
+        return jnp.take(w, idx, axis=0)
+
+    def __repr__(self):
+        return f"Embedding({self.num_embeddings}, {self.embedding_dim})"
+
+
+class LayerNorm(Module):
+    """Layer normalization over the trailing dimension(s)
+    (torch ``nn.LayerNorm`` parity: biased variance, affine by default)."""
+
+    def __init__(self, normalized_shape, eps: float = 1e-5,
+                 elementwise_affine: bool = True):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self.normalized_shape = tuple(normalized_shape)
+        self.eps = eps
+        self.elementwise_affine = elementwise_affine
+
+    def create_params(self, key):
+        if not self.elementwise_affine:
+            return None
+        return {"weight": jnp.ones(self.normalized_shape),
+                "bias": jnp.zeros(self.normalized_shape)}
+
+    def forward(self, x):
+        axes = tuple(range(x.ndim - len(self.normalized_shape), x.ndim))
+        mean = x.mean(axes, keepdims=True)
+        var = ((x - mean) ** 2).mean(axes, keepdims=True)
+        y = (x - mean) * lax.rsqrt(var + self.eps)
+        if self.elementwise_affine:
+            p = _ctx().get_params(self._path)
+            y = y * p["weight"] + p["bias"]
+        return y
+
+    def __repr__(self):
+        return f"LayerNorm({self.normalized_shape})"
+
+
+class GELU(Module):
+    """Gaussian error linear unit (exact erf form, torch default)."""
+
+    def forward(self, x):
+        return jax.nn.gelu(x, approximate=False)
+
+    def __repr__(self):
+        return "GELU()"

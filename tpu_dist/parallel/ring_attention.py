@@ -1,0 +1,137 @@
+"""Sequence-parallel attention: ring attention + Ulysses all-to-all.
+
+Long-context support (first-class here; absent in the reference, SURVEY.md
+§5).  Both functions run *inside* ``shard_map`` over a mesh axis that shards
+the sequence dimension; both are numerically equal to dense attention on the
+gathered sequence (tested in tests/test_ring_attention.py).
+
+**Ring attention** (`ring_self_attention`): each device keeps its Q shard
+resident and rotates K/V shards around the ring with ``lax.ppermute`` —
+the same two-phase neighbor-exchange structure as ring all-reduce
+(/root/reference/README.md:9-20 teaches it for gradients; here it moves KV
+blocks), accumulated with the online-softmax (flash) recurrence so the full
+T×T score matrix never materializes.  Communication per device is O(T/n)
+per hop × n hops = O(T) total, overlapped with the per-block attention
+compute; memory is O((T/n)²) per block.  On TPU the hops ride neighboring
+ICI links.
+
+**Ulysses** (`ulysses_self_attention`): ``lax.all_to_all`` re-shards from
+sequence-sharded to head-sharded, runs dense per-head attention locally,
+and re-shards back.  Cheaper for moderate T (two all-to-alls instead of n
+ppermutes) but requires num_heads divisible by the axis size.
+
+Layout: q, k, v are (batch, T_local, heads, head_dim).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["ring_self_attention", "ulysses_self_attention"]
+
+_NEG_INF = -1e30  # finite sentinel: keeps the online-softmax max/correction
+                  # arithmetic NaN-free when a whole block is causally masked
+
+
+def _block_attend(q, k, v, scale, q_offset, k_offset, causal):
+    """One (Q-shard × KV-block) flash step: returns (num, den, mx) pieces.
+
+    q: (B, Tq, H, D); k/v: (B, Tk, H, D).  Positions are global offsets for
+    causal masking.
+    """
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        qpos = q_offset + jnp.arange(tq)[:, None]
+        kpos = k_offset + jnp.arange(tk)[None, :]
+        scores = jnp.where(kpos <= qpos, scores, _NEG_INF)
+    mx = scores.max(axis=-1)                                  # (B,H,Tq)
+    p = jnp.exp(scores - mx[..., None])
+    # fully-masked rows: mx == _NEG_INF and every p entry is exp(0)=1 — zero
+    # them so they contribute nothing (den also stays 0 until a real block)
+    if causal:
+        p = jnp.where((mx == _NEG_INF)[..., None], 0.0, p)
+    num = jnp.einsum("bhqk,bkhd->bqhd", p, v)                 # (B,Tq,H,D)
+    den = p.sum(axis=-1)                                      # (B,H,Tq)
+    return num, den, mx
+
+
+def ring_self_attention(q, k, v, axis_name: str, causal: bool = False):
+    """Exact attention over the sequence sharded on ``axis_name``.
+
+    Call inside ``shard_map``; per-device shapes (B, T/n, H, D).  Returns the
+    local (B, T/n, H, D) output shard.
+    """
+    n = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    t_local = q.shape[1]
+    # KV blocks travel BACKWARD around the ring (device d sends to d-1), so
+    # at hop i device d holds the block that originated at (d + i) mod n.
+    perm = [(i, (i - 1) % n) for i in range(n)]
+
+    q_offset = me * t_local
+
+    # Accumulator layouts: num (B,Tq,H,D); den/mx (B,H,Tq).
+    def accumulate(i, num, den, mx, kk, vv):
+        src = jnp.mod(me + i, n)
+        bnum, bden, bmx = _block_attend(q, kk, vv, scale,
+                                        q_offset, src * t_local, causal)
+        new_mx = jnp.maximum(mx, bmx)          # (B,H,Tq)
+        c_old = jnp.exp(mx - new_mx)
+        c_new = jnp.exp(bmx - new_mx)
+        # broadcast (B,H,Tq) corrections onto (B,Tq,H,D)
+        co = jnp.moveaxis(c_old, -1, 1)[..., None]   # (B,Tq,H,1)
+        cn = jnp.moveaxis(c_new, -1, 1)[..., None]
+        return num * co + bnum * cn, den * c_old + bden * c_new, new_mx
+
+    def hop(i, carry):
+        # permute-then-attend: the loop runs hops 1..n-1, so exactly n-1
+        # ppermutes happen in total (no wasted final rotation)
+        num, den, mx, kk, vv = carry
+        kk, vv = lax.ppermute((kk, vv), axis_name, perm=perm)
+        num, den, mx = accumulate(i, num, den, mx, kk, vv)
+        return num, den, mx, kk, vv
+
+    num0 = jnp.zeros_like(q)
+    # Derive fresh accumulators from q so they inherit its full varying-axes
+    # (VMA) set — a plain jnp.zeros would be "unvarying" and the fori_loop
+    # carry type would change on the first iteration (works on any mesh,
+    # 1-D 'seq' or N-D like ('data', 'seq')).
+    zero_bht = jnp.moveaxis(q.sum(-1), 1, -1) * 0.0          # (B,H,Tq)
+    num, den, mx = accumulate(0, num0, zero_bht, zero_bht + _NEG_INF, k, v)
+    num, den, mx, _, _ = lax.fori_loop(1, n, hop, (num, den, mx, k, v))
+    den = jnp.moveaxis(den, -1, 1)[..., None]        # (B,Tq,H,1)
+    return num / jnp.maximum(den, 1e-37)
+
+
+def ulysses_self_attention(q, k, v, axis_name: str, causal: bool = False):
+    """Sequence-parallel attention via head redistribution (Ulysses).
+
+    Inside ``shard_map``: (B, T/n, H, D) → all-to-all → (B, T, H/n, D) →
+    dense attention → all-to-all back.  Requires H % axis_size == 0.
+    """
+    n = lax.axis_size(axis_name)
+    if q.shape[2] % n:
+        raise ValueError(
+            f"ulysses needs num_heads ({q.shape[2]}) divisible by the "
+            f"sequence-axis size ({n}); use ring_self_attention instead")
+    from ..nn.attention import scaled_dot_product_attention
+
+    # split heads across devices, gather full sequence
+    def seq_to_heads(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = scaled_dot_product_attention(qh, kh, vh, causal=causal)
+    return heads_to_seq(out)

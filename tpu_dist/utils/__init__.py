@@ -1,0 +1,7 @@
+"""tpu_dist.utils — observability helpers (SURVEY.md §5: the reference's
+tracing/metrics rows are bare prints; these are the structured equivalents)."""
+
+from .logging import MetricLogger, rank_zero_print
+from .profiler import StepTimer, trace
+
+__all__ = ["rank_zero_print", "MetricLogger", "StepTimer", "trace"]

@@ -1,0 +1,66 @@
+"""Rank-gated logging — the reference's pattern, structured.
+
+The reference gates prints on rank 0 (`/root/reference/mpspawn_dist.py:111`,
+`example_mp.py:115`) and tracks running loss/accuracy windows by hand
+(`example_mp.py:111-127`).  These helpers reproduce that with less
+boilerplate and without forcing a device sync every step.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+__all__ = ["rank_zero_print", "MetricLogger"]
+
+
+def rank_zero_print(*args, **kwargs) -> None:
+    """print() only on process rank 0 (works before init: single process)."""
+    from .. import dist as _dist
+    if not _dist.is_initialized() or _dist.get_rank() == 0:
+        print(*args, **kwargs)
+
+
+class MetricLogger:
+    """Windowed metric averaging with rank-0 printing.
+
+    Accepts on-device scalars and defers the host sync to print time (every
+    ``every`` steps) — per-step ``float()`` round-trips are what kill TPU
+    pipelining (SURVEY.md §7 hard parts).
+
+    Usage::
+
+        log = MetricLogger(every=25, fmt="Epoch [{epoch}] Step [{step}] "
+                                          "loss: {loss:.3f}, acc: {acc:.3f}")
+        for i, (x, y) in enumerate(loader):
+            state, m = ddp.train_step(state, x, y)
+            log.push(step=i + 1, epoch=ep + 1, loss=m["loss"],
+                     acc=(m["correct"], batch))
+    """
+
+    def __init__(self, every: int = 25, fmt: Optional[str] = None):
+        self.every = every
+        self.fmt = fmt
+        self._buf: Dict[str, list] = {}
+        self._count = 0
+
+    def push(self, step: int, **metrics) -> Optional[Dict[str, float]]:
+        self._count += 1
+        for k, v in metrics.items():
+            self._buf.setdefault(k, []).append(v)
+        if self._count % self.every:
+            return None
+        out: Dict[str, float] = {}
+        for k, vals in self._buf.items():
+            if isinstance(vals[0], tuple):  # (numerator, denominator) pairs
+                num = sum(float(n) for n, _ in vals)
+                den = sum(float(d) for _, d in vals)
+                out[k] = num / max(den, 1)
+            else:
+                try:
+                    out[k] = sum(float(v) for v in vals) / len(vals)
+                except (TypeError, ValueError):
+                    out[k] = vals[-1]  # non-numeric: keep last
+        self._buf.clear()
+        if self.fmt is not None:
+            rank_zero_print(self.fmt.format(step=step, **out))
+        return out
