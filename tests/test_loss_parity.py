@@ -1,0 +1,67 @@
+"""Loss-curve parity: DDP-8-replica training == single-device training on
+the gathered batches over many steps — the reference's only correctness
+oracle (eyeballed loss curves, SURVEY.md §4), automated."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import tpu_dist.dist as dist
+from tpu_dist import nn, optim
+from tpu_dist.data import (ArrayImageDataset, DataLoader, DeviceLoader,
+                           DistributedSampler)
+from tpu_dist.data.datasets import synthetic_mnist_arrays
+from tpu_dist.models import ConvNet
+from tpu_dist.parallel import DDP
+
+pytestmark = pytest.mark.slow
+
+
+def test_mnist_curve_parity():
+    if dist.is_initialized():
+        dist.destroy_process_group()
+    pg = dist.init_process_group()
+    try:
+        x, y = synthetic_mnist_arrays(True, n=2048)
+        ds = ArrayImageDataset(x, y)
+        model = ConvNet()
+        loss_fn = nn.CrossEntropyLoss()
+
+        # --- DDP run: 8 replicas, global batch 128 ---
+        ddp = DDP(ConvNet(), optimizer=optim.SGD(lr=0.05),
+                  loss_fn=loss_fn, group=pg, donate=False)
+        state = ddp.init(seed=0)
+        loader = DeviceLoader(DataLoader(ds, batch_size=128, drop_last=True),
+                              group=pg)
+        ddp_curve = []
+        for xb, yb in loader:
+            state, m = ddp.train_step(state, xb, yb)
+            ddp_curve.append(float(m["loss"]))
+
+        # --- single-device run: same batches ---
+        params = model.init(jax.random.key(0))
+        opt = optim.SGD(lr=0.05)
+        opt_state = opt.init(params)
+
+        @jax.jit
+        def step(p, s, xb, yb):
+            def l(pp):
+                return loss_fn(model.apply(pp, xb), yb)
+            loss, g = jax.value_and_grad(l)(p)
+            p, s = opt.update(g, s, p)
+            return p, s, loss
+
+        single_curve = []
+        for xb, yb in DataLoader(ds, batch_size=128, drop_last=True):
+            params, opt_state, loss = step(params, opt_state,
+                                           jnp.asarray(xb), jnp.asarray(yb))
+            single_curve.append(float(loss))
+
+        assert len(ddp_curve) == len(single_curve) == 16
+        np.testing.assert_allclose(ddp_curve, single_curve,
+                                   rtol=5e-3, atol=5e-4)
+        # and training must actually progress
+        assert ddp_curve[-1] < ddp_curve[0]
+    finally:
+        dist.destroy_process_group()

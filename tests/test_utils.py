@@ -22,6 +22,11 @@ class TestMetricLogger:
         out = log.push(step=2, acc=(7, 10))
         assert out == {"acc": 0.5}
 
+    def test_fractional_denominator(self):
+        log = MetricLogger(every=1)
+        assert log.push(step=1, frac=(0.3, 0.5)) == {"frac": 0.6}
+        assert log.push(step=2, z=(1.0, 0.0)) == {"z": 0.0}  # empty window
+
     def test_incomplete_window_returns_none(self):
         log = MetricLogger(every=5)
         assert log.push(step=1, loss=1.0) is None

@@ -132,15 +132,18 @@ def restore(root: str, template: Any, step: Optional[int] = None,
             f"missing={missing[:5]}{'…' if len(missing) > 5 else ''} "
             f"extra={extra[:5]}{'…' if len(extra) > 5 else ''}")
     for k, tleaf in flat_t.items():
-        t = np.asarray(tleaf)
-        if tuple(arrays[k].shape) != tuple(t.shape):
+        # metadata-only checks: no np.asarray — that would pull every device
+        # array to host (and fail outright on non-fully-addressable shards)
+        tshape = tuple(np.shape(tleaf))
+        tdtype = np.dtype(getattr(tleaf, "dtype", np.result_type(tleaf)))
+        if tuple(arrays[k].shape) != tshape:
             raise ValueError(
                 f"checkpoint leaf {k!r} shape {arrays[k].shape} != template "
-                f"{t.shape}")
-        if arrays[k].dtype != t.dtype:
+                f"{tshape}")
+        if arrays[k].dtype != tdtype:
             raise ValueError(
                 f"checkpoint leaf {k!r} dtype {arrays[k].dtype} != template "
-                f"{t.dtype}; cast the template (or re-save) explicitly "
+                f"{tdtype}; cast the template (or re-save) explicitly "
                 f"rather than loading silently converted values")
 
     from jax.sharding import Sharding
@@ -152,11 +155,9 @@ def restore(root: str, template: Any, step: Optional[int] = None,
             raise ValueError(
                 "sharding pytree structure does not match template")
 
-    leaves_p, treedef = jax.tree_util.tree_flatten(template)
-    keys = [jax.tree_util.keystr(p)
-            for p, _ in jax.tree_util.tree_leaves_with_path(template)]
+    treedef = jax.tree_util.tree_structure(template)
     out_leaves = []
-    for key in keys:
+    for key in flat_t:  # _flatten preserves leaf order
         a = arrays[key]
         if flat_s[key] is not None:
             a = jax.device_put(a, flat_s[key])

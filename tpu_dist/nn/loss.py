@@ -10,13 +10,22 @@ __all__ = ["CrossEntropyLoss"]
 
 
 class CrossEntropyLoss(Module):
-    """Softmax cross-entropy over integer class labels."""
+    """Softmax cross-entropy over integer class labels.
 
-    def __init__(self, reduction: str = "mean"):
+    ``fused=True`` computes via the Pallas kernel
+    (:func:`tpu_dist.ops.fused_cross_entropy`) — one VMEM-resident pass per
+    row block instead of a materialized log-softmax; worth it for large
+    vocabularies (LM heads)."""
+
+    def __init__(self, reduction: str = "mean", fused: bool = False):
         super().__init__()
         self.reduction = reduction
+        self.fused = fused
 
     def forward(self, logits, labels):
+        if self.fused:
+            from ..ops import fused_cross_entropy
+            return fused_cross_entropy(logits, labels, self.reduction)
         return F.cross_entropy(logits, labels, self.reduction)
 
     # Losses carry no parameters, so allow calling outside apply() too.

@@ -6,9 +6,15 @@ axis_names/mesh_shape)."""
 
 from .ddp import (DistributedDataParallel, TrainState,
                   convert_sync_batchnorm)
+from .gspmd import (PartitionRules, TRANSFORMER_TP_RULES,
+                    make_gspmd_train_step, shard_pytree)
+from .ring_attention import ring_self_attention, ulysses_self_attention
 
 # torch-style alias (the reference imports nn.parallel.DistributedDataParallel)
 DDP = DistributedDataParallel
 
 __all__ = ["DistributedDataParallel", "DDP", "TrainState",
-           "convert_sync_batchnorm"]
+           "convert_sync_batchnorm",
+           "PartitionRules", "TRANSFORMER_TP_RULES",
+           "make_gspmd_train_step", "shard_pytree",
+           "ring_self_attention", "ulysses_self_attention"]

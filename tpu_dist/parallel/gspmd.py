@@ -1,0 +1,112 @@
+"""GSPMD parallelism: shard by annotation, let XLA insert the collectives.
+
+The second parallel programming model next to the explicit ``shard_map`` DDP
+wrapper (ddp.py).  Here you write ordinary single-device training code; the
+*placement of the inputs* (params sharded per rules, batch sharded over
+'data') drives XLA's SPMD partitioner to cut every matmul and insert every
+collective — the scaling-book recipe: pick a mesh, annotate shardings,
+profile, iterate.
+
+This is how tensor parallelism is done TPU-first: no Megatron-style
+Column/RowParallelLinear classes — a *rule* maps parameter paths to
+PartitionSpecs (e.g. attention QKV sharded on the 'model' axis column-wise,
+the output projection row-wise) and XLA emits exactly the all-reduces those
+hand-written layers would contain.  Works combined with data parallelism on
+an N-D mesh (('data', 'model') tested in tests/test_gspmd.py against the
+single-device step).
+
+The reference has no TP (SURVEY.md §2c) — this exists so the mesh design
+demonstrably extends beyond DDP, as §2c's implication row requires.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+__all__ = ["PartitionRules", "shard_pytree", "make_gspmd_train_step",
+           "TRANSFORMER_TP_RULES"]
+
+
+class PartitionRules:
+    """Ordered (path-regex → PartitionSpec) rules; first match wins.
+
+    Paths are the flattened pytree key strings, e.g.
+    ``"['block0.attn']['qkv_weight']"``; regexes are searched, not
+    fullmatched.  Unmatched leaves replicate (P()).
+    """
+
+    def __init__(self, rules: Sequence[Tuple[str, P]]):
+        self.rules = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def spec_for(self, path: str, leaf=None) -> P:
+        for pat, spec in self.rules:
+            if pat.search(path):
+                return spec
+        return P()
+
+    def tree_specs(self, tree):
+        """Pytree of PartitionSpecs matching ``tree``'s structure."""
+        flat = jax.tree_util.tree_leaves_with_path(tree)
+        leaves = [self.spec_for(jax.tree_util.keystr(p), l) for p, l in flat]
+        treedef = jax.tree_util.tree_structure(tree)
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+# Megatron-style transformer sharding over a 'model' mesh axis:
+# - fused QKV and MLP-in sharded column-wise (output features),
+# - attention-out and MLP-out sharded row-wise (input features) — XLA
+#   places the single all-reduce after each row-parallel matmul,
+# - embeddings and LM head sharded on the vocab/feature dimension.
+TRANSFORMER_TP_RULES = PartitionRules([
+    (r"qkv_weight", P(None, "model")),
+    (r"qkv_bias", P("model")),
+    (r"out_weight", P("model", None)),
+    (r"mlp\.0'\]\['weight", P(None, "model")),
+    (r"mlp\.0'\]\['bias", P("model")),
+    (r"mlp\.2'\]\['weight", P("model", None)),
+    (r"\['head'\].*weight", P(None, "model")),
+    (r"\['head'\].*bias", P("model")),
+    (r"\['tok'\].*weight", P("model", None)),
+])
+
+
+def shard_pytree(tree, mesh, rules: Optional[PartitionRules] = None):
+    """``device_put`` every leaf onto ``mesh`` per ``rules`` (default:
+    replicate everything).  The committed shardings then steer jit."""
+    specs = (rules.tree_specs(tree) if rules is not None
+             else jax.tree.map(lambda _: P(), tree))
+    return jax.tree.map(
+        lambda leaf, spec: (None if leaf is None else
+                            jax.device_put(leaf, NamedSharding(mesh, spec))),
+        tree, specs,
+        is_leaf=lambda x: x is None)
+
+
+def make_gspmd_train_step(model, loss_fn, optimizer,
+                          donate: bool = True) -> Callable:
+    """Build the jitted GSPMD step: ordinary single-device code, sharded by
+    its inputs.  Callers place params/opt_state with :func:`shard_pytree`
+    and the batch with a ``P('data', ...)`` sharding; returns
+    ``step(params, opt_state, x, y) -> (params, opt_state, metrics)``.
+
+    NOTE vs the shard_map DDP wrapper: under GSPMD, batch statistics (e.g.
+    BatchNorm) are computed over the **global** batch — sync-BN semantics —
+    because the program is written globally.  The shard_map wrapper is the
+    one matching torch DDP's per-replica BN exactly.
+    """
+
+    def step(params, opt_state, x, y):
+        def loss_of(p):
+            out = model.apply(p, x)
+            return loss_fn(out, y), out
+
+        (loss, out), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
+        new_params, new_opt = optimizer.update(grads, opt_state, params)
+        correct = (out.argmax(-1) == y).sum()
+        return new_params, new_opt, {"loss": loss, "correct": correct}
+
+    return jax.jit(step, donate_argnums=(0, 1) if donate else ())

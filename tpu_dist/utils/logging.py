@@ -54,7 +54,7 @@ class MetricLogger:
             if isinstance(vals[0], tuple):  # (numerator, denominator) pairs
                 num = sum(float(n) for n, _ in vals)
                 den = sum(float(d) for _, d in vals)
-                out[k] = num / max(den, 1)
+                out[k] = num / den if den else 0.0
             else:
                 try:
                     out[k] = sum(float(v) for v in vals) / len(vals)
