@@ -1,0 +1,124 @@
+"""DDP wrapper extensions: grad accumulation, ZeRO-1 optimizer sharding,
+mixed precision — each checked against the plain DDP step's numerics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import tpu_dist.dist as dist
+from tpu_dist import nn, optim
+from tpu_dist.models import ConvNet
+from tpu_dist.parallel import DDP
+
+
+@pytest.fixture
+def pg():
+    if dist.is_initialized():
+        dist.destroy_process_group()
+    pg = dist.init_process_group()
+    yield pg
+    if dist.is_initialized():
+        dist.destroy_process_group()
+
+
+def _batch(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return (jnp.asarray(rng.normal(size=(n, 28, 28, 1)).astype(np.float32)),
+            jnp.asarray(rng.integers(0, 10, n)))
+
+
+def _mk(pg, **kw):
+    return DDP(ConvNet(), optimizer=optim.SGD(lr=0.05, momentum=0.9),
+               loss_fn=nn.CrossEntropyLoss(), group=pg, donate=False, **kw)
+
+
+class TestGradAccumulation:
+    def test_accum_matches_plain(self, pg):
+        """k microbatches of B/k == one batch of B (same grads for
+        mean-reduced loss)."""
+        x, y = _batch(64)
+        plain = _mk(pg)
+        s0 = plain.init(seed=0)
+        s1, m1 = plain.train_step(s0, x, y)
+
+        accum = _mk(pg, accum_steps=4)
+        a0 = accum.init(seed=0)
+        a1, m2 = accum.train_step(a0, x, y)
+
+        np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]),
+                                   rtol=1e-5)
+        assert int(m1["correct"]) == int(m2["correct"])
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6),
+            s1.params, a1.params)
+
+    def test_bad_accum_raises(self, pg):
+        with pytest.raises(ValueError, match="accum_steps"):
+            _mk(pg, accum_steps=0)
+
+
+class TestZero1:
+    def test_matches_plain_over_steps(self, pg):
+        x, y = _batch(64)
+        plain = _mk(pg)
+        z1 = _mk(pg, shard_optimizer=True)
+        sp, sz = plain.init(seed=0), z1.init(seed=0)
+        for _ in range(3):
+            sp, mp = plain.train_step(sp, x, y)
+            sz, mz = z1.train_step(sz, x, y)
+        np.testing.assert_allclose(float(mp["loss"]), float(mz["loss"]),
+                                   rtol=1e-5)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6),
+            sp.params, sz.params)
+
+    def test_opt_state_is_sharded(self, pg):
+        z1 = _mk(pg, shard_optimizer=True)
+        s = z1.init(seed=0)
+        mom = s.opt_state["momentum"]["flat"]
+        assert mom.sharding.spec == P(pg.axis_name)
+        # each device holds 1/8 of the (padded) flat vector
+        assert mom.sharding.shard_shape(mom.shape)[0] == mom.shape[0] // 8
+        # stays sharded after a step
+        x, y = _batch(16)
+        s2, _ = z1.train_step(s, x, y)
+        assert s2.opt_state["momentum"]["flat"].sharding.spec == \
+            P(pg.axis_name)
+
+    def test_zero1_with_accum(self, pg):
+        x, y = _batch(64)
+        plain = _mk(pg)
+        combo = _mk(pg, shard_optimizer=True, accum_steps=2)
+        sp, sc = plain.init(seed=0), combo.init(seed=0)
+        sp, _ = plain.train_step(sp, x, y)
+        sc, _ = combo.train_step(sc, x, y)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6),
+            sp.params, sc.params)
+
+
+class TestMixedPrecision:
+    def test_bf16_trains_params_stay_f32(self, pg):
+        ddp = _mk(pg, compute_dtype=jnp.bfloat16)
+        state = ddp.init(seed=0)
+        x, y = _batch(64)
+        first = None
+        for _ in range(10):
+            state, m = ddp.train_step(state, x, y)
+            first = first if first is not None else float(m["loss"])
+        # master params stay f32
+        assert all(l.dtype == jnp.float32
+                   for l in jax.tree.leaves(state.params))
+        assert float(m["loss"]) < first
+
+    def test_bf16_close_to_f32(self, pg):
+        x, y = _batch(64)
+        f32 = _mk(pg)
+        b16 = _mk(pg, compute_dtype=jnp.bfloat16)
+        s1, m1 = f32.train_step(f32.init(seed=0), x, y)
+        s2, m2 = b16.train_step(b16.init(seed=0), x, y)
+        # bf16 forward: loss agrees to ~1e-2
+        np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]),
+                                   rtol=5e-2)

@@ -48,12 +48,34 @@ __all__ = ["TrainState", "DistributedDataParallel", "convert_sync_batchnorm"]
 
 
 class TrainState(NamedTuple):
-    """Replicated training state threaded through the jitted step."""
+    """Training state threaded through the jitted step.  Replicated over the
+    group — except ``opt_state`` under ``shard_optimizer=True`` (ZeRO-1),
+    which is sharded 1/world per device as a flat vector."""
     params: Any
     model_state: Any      # BN running stats etc.; {} for stateless nets
     opt_state: Any
     step: jnp.ndarray     # scalar int32
     rng: jnp.ndarray      # base PRNG key; per-step/per-replica keys derive
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _flatten_params(tree):
+    """Concatenate all leaves, raveled, in tree-flatten order."""
+    leaves = jax.tree.leaves(tree)
+    return jnp.concatenate([l.ravel() for l in leaves])
+
+
+def _unflatten_params(flat, template):
+    """Inverse of :func:`_flatten_params` (padding tail ignored)."""
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    out, off = [], 0
+    for l in leaves:
+        out.append(flat[off:off + l.size].reshape(l.shape).astype(l.dtype))
+        off += l.size
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 def convert_sync_batchnorm(module: Module, axis_name: str) -> Module:
@@ -88,16 +110,40 @@ class DistributedDataParallel:
 
     def __init__(self, module: Module, optimizer=None, loss_fn=None,
                  group=None, sync_batchnorm: bool = False,
-                 donate: bool = True):
+                 donate: bool = True, compute_dtype=None,
+                 accum_steps: int = 1, shard_optimizer: bool = False):
+        """Options beyond torch-DDP parity (all default off):
+
+        ``compute_dtype``: run forward/backward in this dtype (bf16 for the
+        MXU) while parameters, gradients and optimizer state stay float32
+        master copies — the mixed-precision recipe of BASELINE.md ladder #4.
+
+        ``accum_steps``: split each incoming batch into k microbatches,
+        accumulate gradients locally, and all-reduce ONCE per step — the
+        comms pattern of torch DDP's ``no_sync`` accumulation, compiled as a
+        ``lax.scan``.
+
+        ``shard_optimizer``: ZeRO-1 / cross-replica weight-update sharding
+        (Xu et al., arXiv:2004.13336 — the XLA data-parallel paper): the
+        gradient all-reduce splits into reduce-scatter + all-gather around
+        an optimizer update that each replica performs on only 1/world of
+        the (flattened) parameters, so optimizer state is sharded 1/world
+        per device.  Numerics identical to the dense path (tested).
+        """
         if group is None:
             from .. import dist as _dist
             group = _dist.get_default_group()
+        if accum_steps < 1:
+            raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
         self.module = module
         self.optimizer = optimizer
         self.loss_fn = loss_fn
         self.group = group
         self.axis = group.axis_name
         self.donate = donate
+        self.compute_dtype = compute_dtype
+        self.accum_steps = accum_steps
+        self.shard_optimizer = shard_optimizer
         if sync_batchnorm:
             convert_sync_batchnorm(module, self.axis)
         self._train_step = None
@@ -118,58 +164,152 @@ class DistributedDataParallel:
         key = rng if rng is not None else jax.random.key(seed)
         params = self.module.init(key)
         model_state = self.module.init_state()
-        opt_state = (self.optimizer.init(params)
-                     if self.optimizer is not None else {})
+        if self.optimizer is None:
+            opt_state = {}
+        elif self.shard_optimizer:
+            # ZeRO-1: optimizer state lives on the flattened-and-padded
+            # parameter vector, sharded 1/world per device
+            n = self.group.size()
+            flat = _flatten_params(params)
+            padded = _ceil_to(flat.size, n)
+            opt_state = self.optimizer.init({"flat": jnp.zeros(padded)})
+        else:
+            opt_state = self.optimizer.init(params)
         state = TrainState(params, model_state, opt_state,
                            jnp.zeros((), jnp.int32),
                            jax.random.key_data(jax.random.fold_in(key, 0x5eed)))
-        # commit replicated onto the mesh so donation reuses buffers
+        # commit onto the mesh so donation reuses buffers: everything
+        # replicated except the sharded optimizer vector
         repl = NamedSharding(self.group.mesh, P())
-        return jax.tree.map(lambda a: jax.device_put(a, repl), state)
+        state = jax.tree.map(lambda a: jax.device_put(a, repl), state)
+        if self.shard_optimizer and self.optimizer is not None:
+            osh = NamedSharding(self.group.mesh, P(self.axis))
+            state = state._replace(opt_state=jax.tree.map(
+                lambda a: jax.device_put(a, osh), state.opt_state))
+        return state
 
     # -- compiled steps --------------------------------------------------------
     def _build_train_step(self):
         module, loss_fn, optimizer, axis = (self.module, self.loss_fn,
                                             self.optimizer, self.axis)
         has_state = module.has_state()
+        accum = self.accum_steps
+        cdtype = self.compute_dtype
+        zero1 = self.shard_optimizer
+        n = self.group.size()
 
         def local_step(state: TrainState, x, y):
             params, mstate, opt_state, step, rng_data = state
-            # per-step, per-replica key (dropout/augment must differ by rank
-            # — SURVEY.md §7 per-replica RNG)
-            key = jax.random.wrap_key_data(rng_data)
-            key = jax.random.fold_in(jax.random.fold_in(key, step),
-                                     lax.axis_index(axis))
+            base_key = jax.random.wrap_key_data(rng_data)
 
-            def loss_local(p):
-                if has_state:
-                    out, new_ms = module.apply(p, x, state=mstate,
-                                               training=True, rng=key)
-                else:
-                    out = module.apply(p, x, training=True, rng=key)
-                    new_ms = mstate
-                loss = loss_fn(out, y)
-                # global mean; grad w.r.t. replicated p then carries the
-                # automatic psum of cotangents = DDP-averaged gradient
-                return lax.pmean(loss, axis), (out, new_ms)
+            # Microbatch gradient: params are made device-varying (pvary) so
+            # jax.grad yields LOCAL gradients with no implicit collective —
+            # the all-reduce happens exactly once, after accumulation
+            # (torch DDP `no_sync` accumulation semantics).
+            p_var = jax.tree.map(lambda v: lax.pcast(v, axis, to="varying"), params)
 
-            (loss, (out, new_ms)), grads = jax.value_and_grad(
-                loss_local, has_aux=True)(params)
-            new_params, new_opt = optimizer.update(grads, opt_state, params)
+            def micro(carry, xy):
+                g_acc, loss_acc, correct_acc, ms, i = carry
+                xb, yb = xy
+                # per-step, per-microbatch, per-replica key (SURVEY.md §7:
+                # dropout must differ across ranks)
+                key = jax.random.fold_in(
+                    jax.random.fold_in(base_key, step * accum + i),
+                    lax.axis_index(axis))
+
+                def loss_local(p):
+                    if cdtype is not None:
+                        p = jax.tree.map(
+                            lambda v: v.astype(cdtype)
+                            if jnp.issubdtype(v.dtype, jnp.floating) else v,
+                            p)
+                    xc = (xb.astype(cdtype)
+                          if cdtype is not None and
+                          jnp.issubdtype(xb.dtype, jnp.floating) else xb)
+                    if has_state:
+                        out, new_ms = module.apply(p, xc, state=ms,
+                                                   training=True, rng=key)
+                    else:
+                        out = module.apply(p, xc, training=True, rng=key)
+                        new_ms = ms
+                    return loss_fn(out, yb), (out, new_ms)
+
+                (loss, (out, new_ms)), g = jax.value_and_grad(
+                    loss_local, has_aux=True)(p_var)
+                g_acc = jax.tree.map(jnp.add, g_acc, g)
+                correct = (out.argmax(-1) == yb).sum()
+                return (g_acc, loss_acc + loss, correct_acc + correct,
+                        new_ms, i + 1), None
+
+            if accum > 1:
+                xm = x.reshape((accum, x.shape[0] // accum) + x.shape[1:])
+                ym = y.reshape((accum, y.shape[0] // accum) + y.shape[1:])
+                g0 = jax.tree.map(
+                    lambda v: lax.pcast(jnp.zeros(v.shape, jnp.float32),
+                                        axis, to="varying"), params)
+                init = (g0,
+                        lax.pcast(jnp.zeros((), jnp.float32), axis, to="varying"),
+                        lax.pcast(jnp.zeros((), jnp.int32), axis, to="varying"),
+                        mstate, 0)
+                (g_sum, loss_sum, correct_sum, new_ms, _), _ = lax.scan(
+                    micro, init, (xm, ym))
+                local_grads = jax.tree.map(lambda g: g / accum, g_sum)
+                loss = lax.pmean(loss_sum / accum, axis)
+                correct = lax.psum(correct_sum, axis)
+            else:
+                # fast path: no accumulation scaffolding in the graph
+                zero = jax.tree.map(jnp.zeros_like, p_var)
+                (g_sum, loss_sum, correct_sum, new_ms, _), _ = micro(
+                    (zero, 0.0, 0, mstate, 0), (x, y))
+                local_grads = g_sum
+                loss = lax.pmean(loss_sum, axis)
+                correct = lax.psum(correct_sum, axis)
+
+            if zero1:
+                # reduce-scatter averaged grads; update 1/n of the flat
+                # parameter vector per device; all-gather updated params
+                flat_g = _flatten_params(local_grads)
+                padded = _ceil_to(flat_g.size, n)
+                flat_g = jnp.pad(flat_g, (0, padded - flat_g.size))
+                g_shard = lax.psum_scatter(flat_g, axis, scatter_dimension=0,
+                                           tiled=True) / n
+                flat_p = _flatten_params(params)
+                flat_p = jnp.pad(flat_p, (0, padded - flat_p.size))
+                chunk = padded // n
+                me = lax.axis_index(axis)
+                p_shard = lax.dynamic_slice_in_dim(flat_p, me * chunk, chunk)
+                new_shard, new_opt = optimizer.update(
+                    {"flat": g_shard}, opt_state, {"flat": p_shard})
+                # all-gather the updated shards as a psum of offset-placed
+                # contributions: psum of varying inputs yields a VMA-invariant
+                # (replicated) output, which the P() params out_spec needs —
+                # lax.all_gather would leave the value marked varying
+                contrib = jnp.zeros((padded,), new_shard["flat"].dtype)
+                contrib = lax.dynamic_update_slice_in_dim(
+                    contrib, new_shard["flat"], me * chunk, 0)
+                flat_new = lax.psum(contrib, axis)
+                new_params = _unflatten_params(flat_new, params)
+            else:
+                grads = jax.tree.map(lambda g: lax.pmean(g, axis),
+                                     local_grads)
+                new_params, new_opt = optimizer.update(grads, opt_state,
+                                                       params)
+
             if has_state:
                 # keep replicated-state invariant: average the per-replica
                 # running-stat updates (see module docstring)
                 new_ms = jax.tree.map(lambda v: lax.pmean(v, axis), new_ms)
-            correct = lax.psum((out.argmax(-1) == y).sum(), axis)
             new_state = TrainState(new_params, new_ms, new_opt, step + 1,
                                    rng_data)
             return new_state, {"loss": loss, "correct": correct}
 
         mesh = self.group.mesh
-        state_spec = P()  # fully replicated
+        state_spec = TrainState(params=P(), model_state=P(),
+                                opt_state=P(axis) if zero1 else P(),
+                                step=P(), rng=P())
         fn = jax.shard_map(local_step, mesh=mesh,
                            in_specs=(state_spec, P(axis), P(axis)),
-                           out_specs=(state_spec, state_spec))
+                           out_specs=(state_spec, P()))
         return jax.jit(fn, donate_argnums=(0,) if self.donate else ())
 
     def _build_eval_step(self):
