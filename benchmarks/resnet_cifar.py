@@ -1,0 +1,69 @@
+"""BASELINE.md ladder #4: ResNet-18 CIFAR-10 bf16 DDP images/sec/chip.
+
+The reference workload is /root/reference/example_mp.py:50,84-90 (resnet18,
+batch 256/process, SGD lr .02 / momentum .9 / wd 1e-4 / nesterov); here it
+runs through the same DistributedDataParallel wrapper as training, with
+``compute_dtype=bfloat16`` (f32 master params — the mixed-precision recipe
+the ladder names) and BatchNorm state threading in the fused step.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def run(per_chip_batch: int = 256, steps: int = 50, reps: int = 3) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import tpu_dist.dist as dist
+    from tpu_dist import nn, optim
+    from tpu_dist.models import resnet18
+    from tpu_dist.parallel import DistributedDataParallel
+
+    from .timing import chained_step_time
+
+    own_group = not dist.is_initialized()
+    pg = dist.init_process_group() if own_group else dist.get_default_group()
+    n_chips = dist.get_world_size()
+    batch = per_chip_batch * n_chips
+
+    ddp = DistributedDataParallel(
+        resnet18(num_classes=10),
+        optimizer=optim.SGD(lr=0.02, momentum=0.9, weight_decay=1e-4,
+                            nesterov=True),
+        loss_fn=nn.CrossEntropyLoss(), group=pg, donate=True,
+        compute_dtype=jnp.bfloat16)
+
+    rng = np.random.default_rng(0)
+    sharding = NamedSharding(pg.mesh, P(pg.axis_name))
+    x = jax.device_put(rng.normal(size=(batch, 32, 32, 3)).astype(np.float32),
+                       sharding)
+    y = jax.device_put(rng.integers(0, 10, batch).astype(np.int32), sharding)
+
+    def step(state):
+        new_state, m = ddp.train_step(state, x, y)
+        return new_state, m["loss"]
+
+    t = chained_step_time(step, lambda: ddp.init(seed=0),
+                          steps=steps, reps=reps)
+    result = {
+        "metric": "resnet18_cifar10_bf16_train_images_per_sec_per_chip",
+        "value": round(batch / t / n_chips, 1),
+        "unit": "images/sec/chip",
+        "step_ms": round(t * 1e3, 3),
+        "per_chip_batch": per_chip_batch,
+        "n_chips": n_chips,
+    }
+    if own_group:
+        dist.destroy_process_group()
+    return result
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    print(json.dumps(run()))

@@ -1,0 +1,41 @@
+"""Run the full extended benchmark ladder; write BENCH_EXTENDED.json.
+
+Covers BASELINE.md ladder rows measurable in this sandbox:
+  #1/#2 headline  — bench.py (MNIST ConvNet, printed by the driver)
+  #4              — resnet_cifar (ResNet-18 CIFAR-10 bf16, real chip)
+  #2/#3 stand-in  — scaling (virtual-mesh weak-scaling overhead)
+
+Usage:  python -m benchmarks.run_all
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> None:
+    sys.path.insert(0, _REPO)
+    from benchmarks import resnet_cifar, scaling
+
+    results = []
+    for name, fn in (("resnet_cifar", resnet_cifar.run),
+                     ("scaling", scaling.run)):
+        try:
+            r = fn()
+        except Exception as e:  # record the failure, keep the rest running
+            r = {"metric": name, "error": repr(e)[:500]}
+        print(json.dumps(r))
+        results.append(r)
+
+    out = os.path.join(_REPO, "BENCH_EXTENDED.json")
+    with open(out, "w") as f:
+        json.dump(results, f, indent=1)
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,114 @@
+"""Weak-scaling overhead estimate on a virtual 1..8-device CPU mesh.
+
+Without pod hardware (the sandbox exposes ONE real chip), true ICI scaling
+efficiency (BASELINE.md north star: >=90% linear, 1->32 chips) cannot be
+measured.  What CAN be measured in-repo is the *framework + collective
+overhead* the compiled DDP step adds as the world grows: run the fused step
+at world sizes 1,2,4,8 on ``--xla_force_host_platform_device_count=8`` CPU
+devices with constant per-device batch.
+
+The host may have only ONE physical core, so the N virtual devices' compute
+serializes: ideal weak scaling here is ``t_N = N * t_1``, and we report
+
+    serialized_efficiency(N) = (N * t_1) / t_N
+
+which is 1.0 when the allreduce + shard_map machinery adds nothing beyond
+the serialized compute, and drops below 1.0 by exactly the added overhead.
+On real ICI the compute term is concurrent instead of serial, so this is an
+upper bound on the per-step overhead, not a throughput prediction.
+
+Runs itself in a subprocess with a forced CPU backend (the calling process
+may hold the single-chip axon backend), like __graft_entry__.dryrun_multichip.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _measure(per_device_batch: int = 128, steps: int = 30,
+             reps: int = 3) -> dict:
+    """Run inside a process whose backend is 8 CPU devices."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import tpu_dist.dist as dist
+    from tpu_dist import nn, optim
+    from tpu_dist.models import ConvNet
+    from tpu_dist.parallel import DistributedDataParallel
+    from benchmarks.timing import chained_step_time
+
+    dist.init_process_group(backend="cpu")
+    rng = np.random.default_rng(0)
+    times = {}
+    for n in (1, 2, 4, 8):
+        pg = dist.new_group(ranks=range(n))
+        ddp = DistributedDataParallel(
+            ConvNet(), optimizer=optim.SGD(lr=1e-4),
+            loss_fn=nn.CrossEntropyLoss(), group=pg, donate=True)
+        sharding = NamedSharding(pg.mesh, P(pg.axis_name))
+        batch = per_device_batch * n
+        x = jax.device_put(
+            rng.normal(size=(batch, 28, 28, 1)).astype(np.float32), sharding)
+        y = jax.device_put(rng.integers(0, 10, batch).astype(np.int32),
+                           sharding)
+
+        def step(state, ddp=ddp, x=x, y=y):
+            new_state, m = ddp.train_step(state, x, y)
+            return new_state, m["loss"]
+
+        times[n] = chained_step_time(step, lambda ddp=ddp: ddp.init(seed=0),
+                                     steps=steps, reps=reps)
+    dist.destroy_process_group()
+
+    t1 = times[1]
+    return {
+        "metric": "ddp_weak_scaling_overhead_virtual_cpu_mesh",
+        "step_ms": {str(n): round(t * 1e3, 3) for n, t in times.items()},
+        "serialized_efficiency": {
+            str(n): round(n * t1 / times[n], 3) for n in times},
+        "per_device_batch": per_device_batch,
+        "note": "1-core host: ideal t_N = N*t_1; see module docstring",
+    }
+
+
+def run(per_device_batch: int = 128, steps: int = 30, reps: int = 3) -> dict:
+    """Re-exec on a forced 8-device CPU backend and return the measurement."""
+    code = (
+        "import os\n"
+        "_flag = '--xla_force_host_platform_device_count=8'\n"
+        "if _flag not in os.environ.get('XLA_FLAGS', ''):\n"
+        "    os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS', '')"
+        " + ' ' + _flag).strip()\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        f"import sys; sys.path.insert(0, {_REPO!r})\n"
+        "import json\n"
+        "from benchmarks.scaling import _measure\n"
+        f"print('BENCH_JSON ' + json.dumps(_measure({per_device_batch}, "
+        f"{steps}, {reps})))\n"
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run([sys.executable, "-c", code], cwd=_REPO, env=env,
+                          capture_output=True, text=True, timeout=1800)
+    if proc.returncode != 0:
+        raise RuntimeError(f"scaling child failed (rc={proc.returncode}):\n"
+                           f"{proc.stdout[-2000:]}\n{proc.stderr[-4000:]}")
+    for line in proc.stdout.splitlines():
+        if line.startswith("BENCH_JSON "):
+            return json.loads(line[len("BENCH_JSON "):])
+    raise RuntimeError(f"no BENCH_JSON line in child output:\n"
+                       f"{proc.stdout[-2000:]}")
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, _REPO)
+    print(json.dumps(run()))

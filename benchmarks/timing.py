@@ -1,0 +1,43 @@
+"""Chained-step marginal timing, tunnel-safe.
+
+On the axon tunnel (~100ms RTT) ``jax.block_until_ready`` returns without
+waiting for remote execution; only a device->host readback truly syncs.  So:
+chain ``k`` steps through their state dependency, read back one scalar, and
+take the (long - short) chain difference so the constant dispatch/readback
+overhead cancels.  Donation-safe: a fresh state is built per chain.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+
+def _sync(metrics) -> None:
+    float(metrics)
+
+
+def chained_step_time(step: Callable, make_state: Callable[[], object],
+                      *, steps: int = 100, reps: int = 3,
+                      warmup: int = 5) -> float:
+    """Marginal seconds/step of ``state, scalar = step(state)``.
+
+    ``step`` must return ``(new_state, scalar_metric)`` with the scalar
+    depending on the whole chain (e.g. the loss); ``make_state`` builds a
+    fresh initial state (donated buffers cannot be reused across chains).
+    """
+
+    def chain(k: int) -> float:
+        state = make_state()
+        t0 = time.perf_counter()
+        m = None
+        for _ in range(k):
+            state, m = step(state)
+        _sync(m)
+        return time.perf_counter() - t0
+
+    chain(warmup)  # compile + warm
+    n_short = max(5, steps // 10)
+    d_short = min(chain(n_short) for _ in range(reps))
+    d_long = min(chain(steps + n_short) for _ in range(reps))
+    return (d_long - d_short) / steps
