@@ -31,6 +31,10 @@ def main():
     parser.add_argument("--backend", default="tpu", choices=["tpu", "cpu"])
     parser.add_argument("--data-root", default="./data")
     parser.add_argument("--synthetic", action="store_true")
+    parser.add_argument("--local_rank", default=None, type=int,
+                        help="accepted for the classic launcher argv "
+                             "contract (--pass_local_rank); env LOCAL_RANK "
+                             "is authoritative")
     parser.add_argument("--max-steps", default=0, type=int)
     args = parser.parse_args()
 

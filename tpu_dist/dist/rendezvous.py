@@ -15,17 +15,40 @@ service (a gRPC server on process 0 — the TCPStore analogue), passed to
 After that call every process sees the whole slice via ``jax.devices()``
 and XLA collectives ride ICI/DCN directly — there is no NCCL-communicator
 bootstrap step because communicator construction is part of XLA compilation.
+
+**Control-plane store.**  Alongside the coordination service, a
+:class:`~tpu_dist.dist.store.TCPStore` carries the *control plane* — the
+role torch's TCPStore plays at /root/reference/mpspawn_dist.py:137-138:
+
+- **liveness keys**: every process writes ``tpu_dist/alive/<rank>`` (its
+  pid) on arrival, so the launcher and the pre-flight error can name
+  exactly which ranks are missing instead of hanging;
+- **pre-flight barrier**: all processes meet in the store *before*
+  ``jax.distributed.initialize``, converting a misconfigured WORLD_SIZE or
+  a dead peer from an opaque gRPC timeout into a clear error;
+- **teardown barrier**: processes meet again in :func:`shutdown` before the
+  coordination service goes away, so no rank tears down while another is
+  still flushing its last collective.
+
+The store is used when either (a) ``TPU_DIST_STORE_ADDR=host:port`` is set
+(``tpu_dist.launch`` hosts the server and sets this for its children), or
+(b) ``TPU_DIST_STORE_PREFLIGHT=1`` with ``tcp://`` rendezvous, in which
+case process 0 hosts the server on ``coordinator_port + 1``.  Loss of the
+store degrades with a warning — it is diagnostics, not the data path.
 """
 
 from __future__ import annotations
 
 import os
+import warnings
 from typing import Optional, Tuple
 from urllib.parse import urlparse
 
 __all__ = ["rendezvous", "shutdown", "parse_init_method"]
 
 _distributed_started = False
+_store = None            # control-plane TCPStore client (see module docstring)
+_store_num_processes = 0
 
 
 def parse_init_method(init_method: Optional[str],
@@ -83,6 +106,66 @@ def parse_init_method(init_method: Optional[str],
         f"'tcp://host:port'")
 
 
+def _pf_timeout(timeout: Optional[float]) -> float:
+    return (timeout if timeout is not None else
+            float(os.environ.get("TPU_DIST_PREFLIGHT_TIMEOUT", "300")))
+
+
+def _setup_store(coordinator: str, num_processes: int, process_id: int,
+                 timeout: Optional[float]):
+    """Create (or return) the control-plane store client; None if unused."""
+    global _store, _store_num_processes
+    if _store is not None:
+        return _store
+    from .store import TCPStore
+
+    addr = os.environ.get("TPU_DIST_STORE_ADDR")
+    if addr:
+        host, _, port = addr.rpartition(":")
+        store = TCPStore(host, int(port), timeout=_pf_timeout(timeout))
+    elif os.environ.get("TPU_DIST_STORE_PREFLIGHT"):
+        host, _, port = coordinator.rpartition(":")
+        store = TCPStore(host, int(port) + 1, is_master=(process_id == 0),
+                         timeout=_pf_timeout(timeout))
+    else:
+        return None
+    _store, _store_num_processes = store, num_processes
+    return store
+
+
+def _preflight(store, num_processes: int, process_id: int,
+               timeout: Optional[float]) -> None:
+    """Check in + wait for every peer's liveness key before the gRPC
+    rendezvous.
+
+    Per-rank keys rather than an arrival-counter barrier: idempotent under
+    retry (a second ``init_process_group`` attempt re-asserts the same key
+    instead of double-counting), and a timeout can name exactly the ranks
+    that never appeared.
+    """
+    import time
+
+    pf_timeout = _pf_timeout(timeout)
+    store.set(f"tpu_dist/alive/{process_id}", str(os.getpid()))
+    deadline = time.monotonic() + pf_timeout
+    waiting = set(range(num_processes))
+    delay = 0.01
+    while waiting:
+        waiting = {r for r in waiting
+                   if not store.check(f"tpu_dist/alive/{r}")}
+        if not waiting:
+            return
+        if time.monotonic() > deadline:
+            raise RuntimeError(
+                f"rendezvous pre-flight: only "
+                f"{num_processes - len(waiting)}/{num_processes} processes "
+                f"checked in within {pf_timeout:.0f}s; missing ranks: "
+                f"{sorted(waiting)}. Check WORLD_SIZE/--nnodes and that "
+                f"every rank was actually launched.")
+        time.sleep(delay)
+        delay = min(delay * 2, 0.5)  # back off: don't hammer the server
+
+
 def rendezvous(init_method: Optional[str], world_size: int = -1,
                rank: int = -1, timeout: Optional[float] = None) -> None:
     """Join the coordination service (blocking, like the NCCL rendezvous).
@@ -99,6 +182,21 @@ def rendezvous(init_method: Optional[str], world_size: int = -1,
 
     if _distributed_started:
         return  # already joined
+
+    try:
+        store = _setup_store(coordinator, num_processes, process_id, timeout)
+    except Exception as e:
+        if os.environ.get("TPU_DIST_STORE_PREFLIGHT"):
+            # explicit opt-in: a silent one-sided degradation would leave
+            # the peers stalling against a server that never came up
+            raise RuntimeError(
+                f"TPU_DIST_STORE_PREFLIGHT is set but the pre-flight store "
+                f"could not be set up: {e!r}") from e
+        warnings.warn(f"control-plane store unavailable ({e!r}); continuing "
+                      f"without liveness/pre-flight diagnostics")
+        store = None
+    if store is not None:
+        _preflight(store, num_processes, process_id, timeout)
     # NOTE: must not touch any backend-initializing JAX API here
     # (jax.devices()/process_count()): jax.distributed.initialize has to run
     # before XLA backends exist or it raises.
@@ -114,7 +212,24 @@ def rendezvous(init_method: Optional[str], world_size: int = -1,
 
 
 def shutdown() -> None:
-    global _distributed_started
+    global _distributed_started, _store, _store_num_processes
+    if _store is not None:
+        # teardown barrier: nobody dismantles the coordination service while
+        # a peer is still flushing its last collective.  Short timeout: a
+        # peer that died will never arrive, and the launcher's TERM->KILL
+        # escalation handles us if we linger.
+        try:
+            _store.barrier(
+                _store_num_processes, tag="teardown",
+                timeout=float(os.environ.get("TPU_DIST_TEARDOWN_TIMEOUT",
+                                             "10")))
+        except Exception as e:
+            warnings.warn(f"store teardown barrier failed ({e!r})")
+        try:
+            _store.close()
+        except Exception:
+            pass
+        _store, _store_num_processes = None, 0
     if _distributed_started:
         import jax
         jax.distributed.shutdown()

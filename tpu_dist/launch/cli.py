@@ -9,7 +9,27 @@ This CLI reproduces the exact env contract consumed at
 /root/reference/launch_dist.py:45-46 and example_launch.py:17-18: each child
 gets ``RANK``, ``LOCAL_RANK``, ``WORLD_SIZE``, ``MASTER_ADDR``,
 ``MASTER_PORT`` (plus ``LOCAL_WORLD_SIZE``/``NODE_RANK``), then the script
-calls ``init_process_group(init_method='env://')``.
+calls ``init_process_group(init_method='env://')``.  ``--pass_local_rank``
+additionally appends ``--local_rank=<n>`` to the script's argv (the classic
+torch.distributed.launch contract, /root/reference/README.md:341-343; modern
+env-only delivery is the default, as torchrun does).
+
+**Control-plane TCPStore** (on by default; ``--no_store`` disables): the
+node-0 launcher hosts a :class:`~tpu_dist.dist.store.TCPStore` server (C++
+when the toolchain allows, Python otherwise) and passes its address to every
+child as ``TPU_DIST_STORE_ADDR`` — the role torch's TCPStore plays behind
+``env://`` (/root/reference/mpspawn_dist.py:137-138).  It carries:
+
+- **MASTER_PORT negotiation**: ``--master_port=0`` makes node 0 pick a free
+  port; other nodes read it from the store (fixed ``--store_port`` required
+  in that multi-node case, since the store is then the only known address);
+- **worker liveness**: children check in under ``tpu_dist/alive/<rank>``
+  during rendezvous; if the world hasn't fully checked in after
+  ``--liveness_warn`` seconds the launcher names the missing ranks on
+  stderr instead of letting the rendezvous hang silently;
+- **pre-flight + teardown barriers** inside the children's
+  ``init_process_group``/``destroy_process_group`` (see
+  tpu_dist/dist/rendezvous.py).
 
 TPU deployment note: on a pod slice run ONE launch per host with
 ``--nproc_per_node=1`` (the process drives all local cores); ``WORLD_SIZE``
@@ -23,8 +43,10 @@ from __future__ import annotations
 import argparse
 import os
 import signal
+import socket
 import subprocess
 import sys
+import time
 from typing import List, Optional
 
 __all__ = ["main", "build_parser"]
@@ -41,12 +63,103 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--nnodes", type=int, default=1)
     p.add_argument("--node_rank", type=int, default=0)
     p.add_argument("--master_addr", type=str, default="127.0.0.1")
-    p.add_argument("--master_port", type=int, default=29500)
+    p.add_argument("--master_port", type=int, default=29500,
+                   help="coordination-service port; 0 = negotiate a free "
+                        "port via the store (node 0 picks, others read)")
+    p.add_argument("--store_port", type=int, default=0,
+                   help="control-plane TCPStore port on node 0 (0 = free "
+                        "port single-node, master_port+1 multi-node)")
+    p.add_argument("--no_store", action="store_true",
+                   help="disable the control-plane store (no port "
+                        "negotiation, liveness, or pre-flight)")
+    p.add_argument("--liveness_warn", type=float, default=60.0,
+                   help="seconds before the node-0 launcher reports ranks "
+                        "that have not checked in to the store")
+    p.add_argument("--pass_local_rank", action="store_true",
+                   help="append --local_rank=<n> to the script args "
+                        "(classic torch.distributed.launch argv contract)")
     p.add_argument("--module", "-m", action="store_true",
                    help="treat script as a python module (python -m ...)")
     p.add_argument("script", type=str)
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     return p
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _setup_store(args):
+    """Host (node 0) or connect to the control-plane store.
+
+    Returns ``(store, master_port, store_addr)``; ``store`` is None when
+    disabled or unavailable (a warning is printed — the store is
+    diagnostics + negotiation, not the data path).
+    """
+    if args.no_store:
+        if args.master_port == 0:
+            sys.stderr.write("--master_port=0 needs the store for "
+                             "negotiation; drop --no_store or pick a port\n")
+            return None, None, None
+        return None, args.master_port, None
+
+    from ..dist.store import TCPStore
+
+    try:
+        if args.node_rank == 0:
+            port = args.store_port or (args.master_port + 1
+                                       if args.nnodes > 1 else 0)
+            if args.master_port == 0 and args.nnodes > 1 and not args.store_port:
+                sys.stderr.write(
+                    "--master_port=0 with --nnodes>1 requires an explicit "
+                    "--store_port (the store is then the only known "
+                    "address)\n")
+                return None, None, None
+            store = TCPStore(args.master_addr, port, is_master=True)
+            master_port = (_free_port() if args.master_port == 0
+                           else args.master_port)
+            store.set("tpu_dist/master_port", str(master_port))
+            return store, master_port, f"{args.master_addr}:{store.port}"
+        else:
+            if args.master_port == 0 and not args.store_port:
+                sys.stderr.write(
+                    "--master_port=0 with --node_rank>0 requires the "
+                    "--store_port used on node 0\n")
+                return None, None, None
+            port = args.store_port or args.master_port + 1
+            if args.master_port == 0:
+                # the store is the only known address: connect and read the
+                # negotiated coordinator port (node 0 may start later, so a
+                # generous timeout)
+                store = TCPStore(args.master_addr, port, timeout=120.0)
+                master_port = int(store.get("tpu_dist/master_port"))
+            else:
+                # fixed port: the store address is deterministic, so hand it
+                # to the children without blocking this launcher on a
+                # connect (node 0 may be slow, absent, or --no_store)
+                store, master_port = None, args.master_port
+            return store, master_port, f"{args.master_addr}:{port}"
+    except Exception as e:
+        if args.master_port == 0:
+            sys.stderr.write(f"store setup failed ({e!r}); cannot negotiate "
+                             f"--master_port=0\n")
+            return None, None, None
+        sys.stderr.write(f"store setup failed ({e!r}); launching without "
+                         f"liveness/pre-flight diagnostics\n")
+        return None, args.master_port, None
+
+
+def _check_liveness(store, world_size: int) -> List[int]:
+    """Ranks that have NOT checked in to the store."""
+    try:
+        return [r for r in range(world_size)
+                if not store.check(f"tpu_dist/alive/{r}")]
+    except Exception:
+        return []
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -57,31 +170,72 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
     world_size = args.nproc_per_node * args.nnodes
 
+    store, master_port, store_addr = _setup_store(args)
+    if master_port is None:
+        return 2
+
     procs: List[subprocess.Popen] = []
-    for local_rank in range(args.nproc_per_node):
-        rank = args.node_rank * args.nproc_per_node + local_rank
-        env = dict(os.environ,
-                   RANK=str(rank),
-                   LOCAL_RANK=str(local_rank),
-                   WORLD_SIZE=str(world_size),
-                   LOCAL_WORLD_SIZE=str(args.nproc_per_node),
-                   NODE_RANK=str(args.node_rank),
-                   MASTER_ADDR=args.master_addr,
-                   MASTER_PORT=str(args.master_port))
-        cmd = [sys.executable]
-        if args.module:
-            cmd += ["-m", args.script]
-        else:
-            cmd += [args.script]
-        cmd += args.script_args
-        procs.append(subprocess.Popen(cmd, env=env))
+    try:
+        for local_rank in range(args.nproc_per_node):
+            rank = args.node_rank * args.nproc_per_node + local_rank
+            env = dict(os.environ,
+                       RANK=str(rank),
+                       LOCAL_RANK=str(local_rank),
+                       WORLD_SIZE=str(world_size),
+                       LOCAL_WORLD_SIZE=str(args.nproc_per_node),
+                       NODE_RANK=str(args.node_rank),
+                       MASTER_ADDR=args.master_addr,
+                       MASTER_PORT=str(master_port))
+            if store_addr is not None:
+                env["TPU_DIST_STORE_ADDR"] = store_addr
+            cmd = [sys.executable]
+            if args.module:
+                cmd += ["-m", args.script]
+            else:
+                cmd += [args.script]
+            cmd += args.script_args
+            if args.pass_local_rank:
+                cmd += [f"--local_rank={local_rank}"]
+            procs.append(subprocess.Popen(cmd, env=env))
+    except Exception:
+        # partial world: never leave already-spawned ranks orphaned in the
+        # rendezvous wait
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        if store is not None:
+            try:
+                store.close()
+            except Exception:
+                pass
+        raise
 
     # Fail fast: first non-zero exit kills the rest (mp.spawn-style semantics
     # the reference depends on; torch.distributed.launch exits similarly).
+    # TERM then KILL: jax.distributed installs a SIGTERM handler (preemption
+    # notifier), so a child in rendezvous/teardown survives terminate() and
+    # would otherwise linger until the coordination-service heartbeat
+    # timeout (~100s); escalate to SIGKILL after a grace period.
+    kill_grace = 15.0
     exit_code = 0
+    t0 = time.monotonic()
+    kill_deadline = None
+    liveness_reported = world_size <= 1 or store is None or args.node_rank != 0
     try:
         remaining = set(range(len(procs)))
         while remaining:
+            if (not liveness_reported
+                    and time.monotonic() - t0 > args.liveness_warn):
+                liveness_reported = True
+                missing = _check_liveness(store, world_size)
+                if missing:
+                    sys.stderr.write(
+                        f"[tpu_dist.launch] after {args.liveness_warn:.0f}s "
+                        f"ranks {missing} have not reached rendezvous "
+                        f"(checked-in: {world_size - len(missing)}/"
+                        f"{world_size}); check --nnodes/--node_rank on "
+                        f"every node\n")
             for i in list(remaining):
                 rc = procs[i].poll()
                 if rc is None:
@@ -91,6 +245,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                     exit_code = rc
                     for j in remaining:
                         procs[j].terminate()
+                    kill_deadline = time.monotonic() + kill_grace
+            if (kill_deadline is not None
+                    and time.monotonic() > kill_deadline):
+                for j in remaining:
+                    if procs[j].poll() is None:
+                        procs[j].kill()
             if remaining:
                 try:
                     procs[next(iter(remaining))].wait(timeout=0.2)
@@ -100,7 +260,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         for p in procs:
             if p.poll() is None:
                 p.send_signal(signal.SIGINT)
+        deadline = time.monotonic() + kill_grace
         for p in procs:
-            p.wait()
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
         exit_code = 130
+    finally:
+        if store is not None:
+            try:
+                store.close()
+            except Exception:
+                pass
     return exit_code
