@@ -73,12 +73,19 @@ def main():
           f"{dist.get_world_size()} device replicas ==")
 
     model = resnet18(num_classes=10)
+    compute_dtype = None
+    if args.bf16:
+        import jax.numpy as jnp
+        # mixed precision the TPU way: bf16 forward/backward on the MXU,
+        # float32 master params + optimizer state (casting the params
+        # themselves would be undone by the first f32 update)
+        compute_dtype = jnp.bfloat16
     ddp = DistributedDataParallel(
         model,
         optimizer=optim.SGD(lr=0.01 * 2, momentum=0.9, weight_decay=1e-4,
                             nesterov=True),
         loss_fn=nn.CrossEntropyLoss(), group=pg,
-        sync_batchnorm=args.sync_bn)
+        sync_batchnorm=args.sync_bn, compute_dtype=compute_dtype)
     state = ddp.init(seed=0)
 
     aug = transforms.Compose([
@@ -96,12 +103,6 @@ def main():
                    sampler=sampler, drop_last=True, num_workers=4,
                    pin_memory=True),
         group=pg)
-
-    if args.bf16:
-        import jax.numpy as jnp
-        state = state._replace(params=jax.tree.map(
-            lambda a: a.astype(jnp.bfloat16) if a.dtype == jnp.float32 else a,
-            state.params))
 
     total_step = len(loader.loader)
     start = datetime.now()

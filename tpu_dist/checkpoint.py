@@ -9,9 +9,22 @@ checkpoint is a directory holding
 - ``arrays.npz`` — the leaf arrays, keyed by flattened path.
 
 Writes are atomic (tmp dir + rename), step-numbered
-(``<root>/step_00000100/``), and multi-host safe: only process 0 writes
-(state is replicated under DDP), every process restores.  ``latest_step``
-finds the newest checkpoint for resume.
+(``<root>/step_00000100/``), and multi-host safe: only process 0 writes,
+every process restores.  ``latest_step`` finds the newest checkpoint for
+resume.
+
+Sharded state is handled on both sides:
+
+- **save**: leaves that are not fully addressable (multi-host shardings)
+  are all-gathered across processes before process 0 writes — so every
+  process MUST call :func:`save` (it is a collective in that case);
+  fully-addressable sharded leaves (e.g. single-host ZeRO-1 opt_state)
+  gather locally via ``np.asarray``.
+- **restore**: pass ``sharding=`` to re-place leaves;
+  :meth:`tpu_dist.parallel.DistributedDataParallel.state_shardings` builds
+  the matching pytree for a TrainState (replicated params, ZeRO-1-sharded
+  opt_state) so a ``shard_optimizer=True`` state round-trips with its
+  P(axis) placement intact.
 
 Works on any pytree of arrays — :class:`tpu_dist.parallel.TrainState`
 included (its PRNG key is stored as key *data*, a plain uint32 array).
@@ -39,25 +52,50 @@ def _flatten(tree):
     return {jax.tree_util.keystr(path): leaf for path, leaf in leaves}
 
 
+def _materialize(leaf) -> np.ndarray:
+    """Bring a leaf fully to host.
+
+    Non-fully-addressable jax.Arrays (multi-host shardings, incl. multi-host
+    ZeRO-1 opt_state) are all-gathered across processes — a COLLECTIVE, so
+    every process must reach this point; fully-addressable leaves (host
+    arrays, replicated or single-host-sharded device arrays) convert
+    directly.
+    """
+    import jax
+
+    if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(leaf, tiled=True))
+    return np.asarray(leaf)
+
+
 def save(root: str, tree: Any, step: int, metadata: Optional[Dict] = None,
          keep: Optional[int] = None) -> str:
     """Write checkpoint ``root/step_{step:08d}``; returns its path.
 
     ``keep=N`` prunes to the newest N step dirs after a successful write.
-    Only process 0 writes; other processes return the target path without
-    touching disk (call :func:`tpu_dist.dist.barrier` after if you need
-    completion before proceeding).
+    Only process 0 writes, but when the tree holds non-fully-addressable
+    (multi-host-sharded) leaves EVERY process must call save — the gather
+    of those leaves is a collective.  Non-zero processes return the target
+    path without touching disk (call :func:`tpu_dist.dist.barrier` after if
+    you need completion before proceeding).
     """
     import jax
 
     path = os.path.join(root, f"step_{step:08d}")
     if jax.process_index() != 0:
+        # participate in the collective gather of non-addressable leaves,
+        # write nothing
+        for leaf in _flatten(tree).values():
+            if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+                _materialize(leaf)
         return path
-    flat = _flatten(tree)
+    # materialize (the collective part) BEFORE any fallible filesystem op:
+    # a proc-0 I/O error must raise, not strand peers inside the allgather
+    arrays = {k: _materialize(v) for k, v in _flatten(tree).items()}
     os.makedirs(root, exist_ok=True)
     tmp = tempfile.mkdtemp(dir=root, prefix=".tmp_ckpt_")
     try:
-        arrays = {k: np.asarray(v) for k, v in flat.items()}
         np.savez(os.path.join(tmp, "arrays.npz"), **arrays)
         meta = {
             "step": step,

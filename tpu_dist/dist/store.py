@@ -474,16 +474,23 @@ class FileStore(Store):
         with open(self._file(key), "rb") as f:
             return f.read()
 
+    def _lock_file(self, key: str) -> str:
+        # own namespace (dot-dir): can't collide with a key named
+        # '<key>.lock', and num_keys/check never see it
+        d = os.path.join(self.path, ".locks")
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(d, os.path.basename(self._file(key)))
+
     def add(self, key: str, delta: int) -> int:
-        # Cross-process atomicity via a lockfile.
-        lock = self._file(key) + ".lock"
-        while True:
-            try:
-                fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-                break
-            except FileExistsError:
-                time.sleep(0.005)
+        # Cross-process atomicity via flock on a persistent lock file: the
+        # kernel releases the lock when the holder dies, so a crash between
+        # acquire and release cannot wedge every other rank (unlike a
+        # create/unlink lockfile scheme).
+        import fcntl
+
+        fd = os.open(self._lock_file(key), os.O_CREAT | os.O_WRONLY, 0o644)
         try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
             cur = 0
             if os.path.exists(self._file(key)):
                 with open(self._file(key), "rb") as f:
@@ -493,13 +500,17 @@ class FileStore(Store):
             self.set(key, struct.pack("<q", nv))
             return nv
         finally:
-            os.close(fd)
-            os.unlink(lock)
+            os.close(fd)  # releases the flock; lock file stays
 
     def check(self, key: str) -> bool:
         return os.path.exists(self._file(key))
 
     def delete_key(self, key: str) -> bool:
+        try:
+            os.unlink(os.path.join(self.path, ".locks",
+                                   os.path.basename(self._file(key))))
+        except OSError:
+            pass
         try:
             os.unlink(self._file(key))
             return True
@@ -508,4 +519,4 @@ class FileStore(Store):
 
     def num_keys(self) -> int:
         return len([f for f in os.listdir(self.path)
-                    if not f.endswith((".tmp", ".lock"))])
+                    if not f.startswith(".") and not f.endswith(".tmp")])

@@ -178,15 +178,23 @@ class DistributedDataParallel:
         state = TrainState(params, model_state, opt_state,
                            jnp.zeros((), jnp.int32),
                            jax.random.key_data(jax.random.fold_in(key, 0x5eed)))
-        # commit onto the mesh so donation reuses buffers: everything
-        # replicated except the sharded optimizer vector
+        # commit onto the mesh so donation reuses buffers; the layout policy
+        # (replicated everywhere, ZeRO-1-sharded opt_state) lives in
+        # state_shardings so checkpoints restore to exactly this placement
+        return jax.tree.map(jax.device_put, state, self.state_shardings(state))
+
+    def state_shardings(self, state: TrainState) -> TrainState:
+        """Pytree of :class:`NamedSharding` mirroring ``state``'s layout:
+        everything replicated except ZeRO-1-sharded ``opt_state``
+        (``P(axis)``).  Feed to ``tpu_dist.checkpoint.restore(sharding=...)``
+        so a restored TrainState lands with its original placement."""
         repl = NamedSharding(self.group.mesh, P())
-        state = jax.tree.map(lambda a: jax.device_put(a, repl), state)
+        shardings = jax.tree.map(lambda _: repl, state)
         if self.shard_optimizer and self.optimizer is not None:
             osh = NamedSharding(self.group.mesh, P(self.axis))
-            state = state._replace(opt_state=jax.tree.map(
-                lambda a: jax.device_put(a, osh), state.opt_state))
-        return state
+            shardings = shardings._replace(
+                opt_state=jax.tree.map(lambda _: osh, state.opt_state))
+        return shardings
 
     # -- compiled steps --------------------------------------------------------
     def _build_train_step(self):
