@@ -1,0 +1,98 @@
+"""Eager c10d surface beyond allreduce/allgather/broadcast (VERDICT r1
+missing #2): reduce, gather, scatter, send/recv, full ReduceOp parity.
+
+Single-process semantics here; the true multi-process paths (including
+store-backed send/recv) run in test_eager_c10d_e2e 2-process workers.
+Torch-semantics oracle: reduce returns on dst only, gather list indexed by
+rank, scatter from src's list, send/recv matched by program order."""
+
+import numpy as np
+import pytest
+
+import tpu_dist.dist as dist
+from tpu_dist import collectives as C
+
+
+@pytest.fixture
+def pg():
+    if dist.is_initialized():
+        dist.destroy_process_group()
+    pg = dist.init_process_group()
+    yield pg
+    if dist.is_initialized():
+        dist.destroy_process_group()
+
+
+def test_reduceop_constants():
+    assert dist.ReduceOp is C.ReduceOp
+    assert C.ReduceOp.SUM == "sum" and C.ReduceOp.BXOR == "bxor"
+
+
+@pytest.mark.parametrize("op", ["sum", "avg", "product", "min", "max"])
+def test_all_reduce_ops_single_process(pg, op):
+    x = np.array([3.0, 4.0])
+    out = C.all_reduce_host(x, group=pg, op=op)
+    np.testing.assert_array_equal(out, x)  # world of one: identity
+
+
+@pytest.mark.parametrize("op", ["band", "bor", "bxor"])
+def test_all_reduce_bitwise_single_process(pg, op):
+    x = np.array([0b1100, 0b1010], np.int32)
+    np.testing.assert_array_equal(C.all_reduce_host(x, group=pg, op=op), x)
+
+
+def test_all_reduce_unknown_op_raises(pg):
+    with pytest.raises(ValueError, match="Unknown reduce op"):
+        C.all_reduce_host(np.zeros(2), group=pg, op="median")
+
+
+def test_reduce_host_dst_semantics(pg):
+    x = np.array([1.0, 2.0])
+    np.testing.assert_array_equal(C.reduce_host(x, dst=0, group=pg), x)
+
+
+def test_gather_host_single(pg):
+    out = C.gather_host(np.array([7]), dst=0, group=pg)
+    assert isinstance(out, list) and len(out) == 1
+    np.testing.assert_array_equal(out[0], [7])
+
+
+def test_scatter_host_single(pg):
+    out = C.scatter_host(np.zeros(2), scatter_list=[np.array([5.0, 6.0])],
+                         src=0, group=pg)
+    np.testing.assert_array_equal(out, [5.0, 6.0])
+
+
+def test_scatter_wrong_list_length(pg):
+    with pytest.raises(ValueError, match="num_processes"):
+        C.scatter_host(np.zeros(2), scatter_list=[np.zeros(2), np.zeros(2)],
+                       src=0, group=pg)
+
+
+def test_send_to_self_raises(pg):
+    with pytest.raises(ValueError, match="self"):
+        C.send(np.zeros(2), dst=0, group=pg)
+    with pytest.raises(ValueError, match="self"):
+        C.recv(src=0, group=pg)
+
+
+def test_send_requires_store(pg):
+    # rank 1 doesn't exist in a single-process world -> range error first
+    with pytest.raises(ValueError, match="out of range"):
+        C.send(np.zeros(2), dst=1, group=pg)
+
+
+def test_reduce_fn_table_matches_numpy():
+    """The op table itself (what multi-process runs use) vs numpy oracle."""
+    from tpu_dist.collectives.eager import _reduce_fn
+    stacked = np.array([[1, 2, 3], [4, 5, 6], [7, 8, 9]], np.int32)
+    np.testing.assert_array_equal(_reduce_fn("sum")(stacked), [12, 15, 18])
+    np.testing.assert_array_equal(_reduce_fn("product")(stacked),
+                                  [28, 80, 162])
+    np.testing.assert_array_equal(_reduce_fn("min")(stacked), [1, 2, 3])
+    np.testing.assert_array_equal(_reduce_fn("max")(stacked), [7, 8, 9])
+    np.testing.assert_allclose(_reduce_fn("avg")(stacked), [4.0, 5.0, 6.0])
+    bits = np.array([[0b1100], [0b1010]], np.int32)
+    np.testing.assert_array_equal(_reduce_fn("band")(bits), [0b1000])
+    np.testing.assert_array_equal(_reduce_fn("bor")(bits), [0b1110])
+    np.testing.assert_array_equal(_reduce_fn("bxor")(bits), [0b0110])

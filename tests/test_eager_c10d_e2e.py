@@ -1,0 +1,108 @@
+"""2-process e2e for the extended eager c10d surface: reduce/gather/scatter
+across processes, full ReduceOp set, and store-backed send/recv (the
+TCPStore point-to-point path).  Launched through tpu_dist.launch so the
+control-plane store is wired exactly as in production."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+pytestmark = [pytest.mark.multiprocess, pytest.mark.slow]
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = textwrap.dedent("""
+    import json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import tpu_dist.dist as dist
+    from tpu_dist import collectives as C
+
+    pg = dist.init_process_group(backend="cpu", init_method="env://")
+    r = dist.get_rank()
+    out = {"rank": r}
+
+    # full ReduceOp set across 2 processes (values rank+1 -> 1, 2)
+    x = np.array([r + 1, (r + 1) * 4], np.int32)
+    for op in ("sum", "product", "min", "max", "band", "bor", "bxor"):
+        out[f"allreduce_{op}"] = C.all_reduce_host(x, group=pg, op=op).tolist()
+    out["allreduce_avg"] = C.all_reduce_host(
+        x.astype(np.float64), group=pg, op=C.ReduceOp.AVG).tolist()
+
+    # reduce: lands on dst=1 only
+    red = C.reduce_host(x, dst=1, group=pg)
+    out["reduce_dst1"] = None if red is None else red.tolist()
+
+    # gather at dst=0
+    g = C.gather_host(np.array([10 * r]), dst=0, group=pg)
+    out["gather_dst0"] = None if g is None else [np.asarray(e).tolist() for e in g]
+
+    # scatter from src=1
+    sl = ([np.array([100.0]), np.array([200.0])] if r == 1 else None)
+    out["scattered"] = C.scatter_host(np.zeros(1), scatter_list=sl,
+                                      src=1, group=pg).tolist()
+
+    # send/recv ping-pong through the store (two messages each way checks
+    # sequence numbering; tag isolates a side channel)
+    if r == 0:
+        C.send(np.arange(3, dtype=np.int64), dst=1, group=pg)
+        C.send(np.array([42.5]), dst=1, group=pg)
+        out["pong"] = C.recv(src=1, group=pg).tolist()
+        out["tagged"] = C.recv(src=1, group=pg, tag=7).tolist()
+    else:
+        a = C.recv(src=0, group=pg)
+        b = C.recv(src=0, group=pg)
+        out["got"] = [a.tolist(), b.tolist()]
+        C.send(a * 2, dst=0, group=pg)
+        C.send(np.array([9, 9]), dst=0, group=pg, tag=7)
+
+    dist.barrier()
+    with open(sys.argv[1] + f"/result{r}.json", "w") as f:
+        json.dump(out, f)
+    dist.destroy_process_group()
+""")
+
+
+def test_eager_c10d_two_processes(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_dist.launch", "--nproc_per_node=2",
+         "--master_port=0", str(script), str(tmp_path)],
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    res = {}
+    for rank in range(2):
+        with open(tmp_path / f"result{rank}.json") as f:
+            res[rank] = json.load(f)
+
+    # ranks contributed [1,4] and [2,8]
+    for rank in res:
+        assert res[rank]["allreduce_sum"] == [3, 12]
+        assert res[rank]["allreduce_product"] == [2, 32]
+        assert res[rank]["allreduce_min"] == [1, 4]
+        assert res[rank]["allreduce_max"] == [2, 8]
+        assert res[rank]["allreduce_band"] == [1 & 2, 4 & 8]
+        assert res[rank]["allreduce_bor"] == [1 | 2, 4 | 8]
+        assert res[rank]["allreduce_bxor"] == [1 ^ 2, 4 ^ 8]
+        assert res[rank]["allreduce_avg"] == [1.5, 6.0]
+
+    assert res[0]["reduce_dst1"] is None
+    assert res[1]["reduce_dst1"] == [3, 12]
+    assert res[0]["gather_dst0"] == [[0], [10]]
+    assert res[1]["gather_dst0"] is None
+    assert res[0]["scattered"] == [100.0]
+    assert res[1]["scattered"] == [200.0]
+
+    # p2p: rank 1 saw both messages in order; pong is first*2; tag-7 channel
+    assert res[1]["got"] == [[0, 1, 2], [42.5]]
+    assert res[0]["pong"] == [0, 2, 4]
+    assert res[0]["tagged"] == [9, 9]

@@ -1,6 +1,7 @@
 """Eager host-level collectives on a ProcessGroup.
 
-torch call-style parity (``dist.all_reduce(tensor)``,
+torch call-style parity (``dist.all_reduce(tensor)``, ``dist.reduce``,
+``dist.gather``/``scatter``, ``dist.send``/``recv`` —
 /root/reference/README.md:38-43 usage flow) for out-of-graph syncs: metric
 averaging, init-time parameter broadcast, debugging.  NOT for the training
 hot path — there the all-reduce is fused into the jitted step
@@ -11,14 +12,61 @@ across all processes of the group (one leader device per process carries the
 payload).  Single-process groups are a fast no-op/copy, so the same training
 script runs unchanged from 1 host to a pod (the property the reference gets
 from torch.distributed working at world_size=1).
+
+Point-to-point ``send``/``recv`` ride the control-plane TCPStore (the c10d
+TCPStore analogue, tpu_dist/dist/store.py) — available whenever the job was
+brought up through ``tpu_dist.launch`` (default) or with
+``TPU_DIST_STORE_ADDR``/``TPU_DIST_STORE_PREFLIGHT`` set.
 """
 
 from __future__ import annotations
 
+import io
+from typing import List, Optional
+
 import jax
 import numpy as np
 
-__all__ = ["all_reduce_host", "all_gather_host", "broadcast_host"]
+__all__ = ["ReduceOp", "all_reduce_host", "all_gather_host",
+           "broadcast_host", "reduce_host", "gather_host", "scatter_host",
+           "send", "recv"]
+
+
+class ReduceOp:
+    """torch.distributed.ReduceOp parity (string-valued; the *_host
+    collectives accept either these constants or the lowercase strings)."""
+    SUM = "sum"
+    AVG = "avg"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+    BAND = "band"
+    BOR = "bor"
+    BXOR = "bxor"
+
+
+# op name -> numpy ufunc reduced over the process axis; avg handled apart
+_REDUCE_UFUNCS = {
+    "sum": np.add,
+    "prod": np.multiply,
+    "product": np.multiply,
+    "min": np.minimum,
+    "max": np.maximum,
+    "band": np.bitwise_and,
+    "bor": np.bitwise_or,
+    "bxor": np.bitwise_xor,
+}
+
+
+def _reduce_fn(op: str):
+    op = op.lower()
+    if op in ("avg", "mean"):
+        return lambda v: np.mean(v, axis=0)
+    if op in _REDUCE_UFUNCS:
+        ufunc = _REDUCE_UFUNCS[op]
+        return lambda v: ufunc.reduce(v, axis=0)
+    raise ValueError(f"Unknown reduce op {op!r}; one of "
+                     f"{sorted(_REDUCE_UFUNCS) + ['avg']}")
 
 
 def _default_group(group):
@@ -28,24 +76,16 @@ def _default_group(group):
     return group
 
 
-def all_reduce_host(x, group=None, op: str = "sum"):
+def all_reduce_host(x, group=None, op: str = ReduceOp.SUM):
     """Reduce a per-process host value across processes; returns the reduced
     value on host (as numpy / python scalar tree)."""
     group = _default_group(group)
-    np_op = {"sum": None, "avg": None, "mean": None, "max": np.maximum,
-             "min": np.minimum}
-    if op.lower() not in np_op:
-        raise ValueError(f"Unknown reduce op {op!r}")
+    fn = _reduce_fn(op)  # validate op before the fast path returns
     if group.num_processes <= 1:
         return jax.tree.map(np.asarray, x)
     from jax.experimental import multihost_utils
     gathered = multihost_utils.process_allgather(x)  # leading axis = process
-    if op.lower() == "sum":
-        return jax.tree.map(lambda v: np.sum(v, axis=0), gathered)
-    if op.lower() in ("avg", "mean"):
-        return jax.tree.map(lambda v: np.mean(v, axis=0), gathered)
-    fn = np_op[op.lower()]
-    return jax.tree.map(lambda v: fn.reduce(v, axis=0), gathered)
+    return jax.tree.map(fn, gathered)
 
 
 def all_gather_host(x, group=None):
@@ -66,3 +106,141 @@ def broadcast_host(x, group=None, src: int = 0):
     from jax.experimental import multihost_utils
     return multihost_utils.broadcast_one_to_all(
         x, is_source=group.rank == src)
+
+
+def _check_peer(rank: int, group, what: str) -> None:
+    if not 0 <= rank < group.num_processes:
+        raise ValueError(f"{what} {rank} out of range "
+                         f"(num_processes={group.num_processes})")
+
+
+def reduce_host(x, dst: int = 0, group=None, op: str = ReduceOp.SUM):
+    """torch ``dist.reduce`` parity: the reduced value lands on process
+    ``dst`` (returned there); every other process gets ``None``."""
+    group = _default_group(group)
+    fn = _reduce_fn(op)
+    _check_peer(dst, group, "dst")
+    if group.num_processes <= 1:
+        return jax.tree.map(np.asarray, x)
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(x)
+    if group.rank != dst:
+        return None
+    return jax.tree.map(fn, gathered)
+
+
+def gather_host(x, dst: int = 0, group=None) -> Optional[List]:
+    """torch ``dist.gather`` parity: process ``dst`` returns the list of all
+    processes' values (index = rank); everyone else gets ``None``."""
+    group = _default_group(group)
+    _check_peer(dst, group, "dst")
+    if group.num_processes <= 1:
+        return [jax.tree.map(np.asarray, x)]
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(x)
+    if group.rank != dst:
+        return None
+    n = group.num_processes
+    return [jax.tree.map(lambda v: v[r], gathered) for r in range(n)]
+
+
+def scatter_host(output_template, scatter_list: Optional[List] = None,
+                 src: int = 0, group=None):
+    """torch ``dist.scatter`` parity: process ``src`` supplies
+    ``scatter_list`` with one entry per process; every process returns its
+    entry.  ``output_template`` plays the role of torch's preallocated
+    output tensor: a value (tree) of the shape/dtype being received.  As in
+    torch's NCCL scatter, every entry must share that shape/dtype (the wire
+    format is uniform).  Non-source processes pass ``scatter_list=None``."""
+    group = _default_group(group)
+    n = group.num_processes
+    _check_peer(src, group, "src")
+    if group.rank == src:
+        if scatter_list is None or len(scatter_list) != n:
+            raise ValueError(
+                f"scatter src must pass scatter_list with num_processes="
+                f"{n} entries, got "
+                f"{None if scatter_list is None else len(scatter_list)}")
+        payload = [jax.tree.map(np.asarray, e) for e in scatter_list]
+        tshape = jax.tree.map(lambda v: np.asarray(v).shape, output_template)
+        for i, e in enumerate(payload):
+            eshape = jax.tree.map(lambda v: v.shape, e)
+            if eshape != tshape:
+                raise ValueError(
+                    f"scatter_list[{i}] shape {eshape} != output_template "
+                    f"shape {tshape}: entries must be uniform (NCCL scatter "
+                    f"semantics)")
+        if n <= 1:
+            return payload[0]
+    else:
+        payload = [jax.tree.map(lambda v: np.zeros_like(np.asarray(v)),
+                                output_template) for _ in range(n)]
+    # one broadcast of the full list, then local pick: simple and correct;
+    # an O(1)-per-rank path would ride the store like send/recv
+    from jax.experimental import multihost_utils
+    full = multihost_utils.broadcast_one_to_all(
+        payload, is_source=group.rank == src)
+    return jax.tree.map(np.asarray, full[group.rank])
+
+
+# -- point-to-point over the control-plane store ------------------------------
+
+_p2p_send_seq: dict = {}   # (me, dst, tag) -> next sequence number
+_p2p_recv_seq: dict = {}   # (src, me, tag) -> next sequence number
+
+
+def _p2p_store():
+    # importlib: `from ..dist import rendezvous` would fetch the FUNCTION
+    # re-exported by dist/__init__, not the module
+    import importlib
+    rdzv = importlib.import_module("tpu_dist.dist.rendezvous")
+    if rdzv._store is None:
+        raise RuntimeError(
+            "send/recv need the control-plane store: bring the job up via "
+            "tpu_dist.launch (default), or set TPU_DIST_STORE_ADDR, or use "
+            "TPU_DIST_STORE_PREFLIGHT=1 with tcp:// rendezvous")
+    return rdzv._store
+
+
+def _p2p_key(src: int, dst: int, tag: int, seq: int) -> str:
+    return f"tpu_dist/p2p/{src}->{dst}/t{tag}/{seq}"
+
+
+def send(x, dst: int, group=None, tag: int = 0) -> None:
+    """torch ``dist.send`` parity: deliver this process's array to process
+    ``dst``.  Matched by program order per (src, dst, tag), like torch.
+    Buffered through the store server, so send does not block on the
+    receiver."""
+    group = _default_group(group)
+    me = group.rank
+    if dst == me:
+        raise ValueError("send to self deadlocks (torch semantics)")
+    if not 0 <= dst < group.num_processes:
+        raise ValueError(f"dst {dst} out of range "
+                         f"(num_processes={group.num_processes})")
+    store = _p2p_store()
+    seq = _p2p_send_seq.get((me, dst, tag), 0)
+    _p2p_send_seq[(me, dst, tag)] = seq + 1
+    buf = io.BytesIO()
+    np.save(buf, np.asarray(x), allow_pickle=False)
+    store.set(_p2p_key(me, dst, tag, seq), buf.getvalue())
+
+
+def recv(src: int, group=None, tag: int = 0) -> np.ndarray:
+    """torch ``dist.recv`` parity: block until the matching :func:`send`
+    from ``src`` arrives; returns the array (no preallocated output buffer
+    needed — shape/dtype travel on the wire)."""
+    group = _default_group(group)
+    me = group.rank
+    if src == me:
+        raise ValueError("recv from self deadlocks (torch semantics)")
+    if not 0 <= src < group.num_processes:
+        raise ValueError(f"src {src} out of range "
+                         f"(num_processes={group.num_processes})")
+    store = _p2p_store()
+    seq = _p2p_recv_seq.get((src, me, tag), 0)
+    _p2p_recv_seq[(src, me, tag)] = seq + 1
+    key = _p2p_key(src, me, tag, seq)
+    raw = store.get(key)  # blocks until the key exists
+    store.delete_key(key)
+    return np.load(io.BytesIO(raw), allow_pickle=False)

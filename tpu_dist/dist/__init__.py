@@ -12,6 +12,7 @@ from .process_group import (DATA_AXIS, ProcessGroup, barrier,
                             init_process_group, is_initialized, new_group)
 from .rendezvous import parse_init_method, rendezvous
 from .store import Store, TCPStore, FileStore
+from ..collectives.eager import ReduceOp  # torch `dist.ReduceOp` parity
 
 __all__ = [
     "ProcessGroup", "init_process_group", "destroy_process_group",
@@ -19,5 +20,5 @@ __all__ = [
     "get_local_rank", "get_local_world_size", "get_num_processes",
     "new_group", "barrier", "DATA_AXIS",
     "rendezvous", "parse_init_method",
-    "Store", "TCPStore", "FileStore",
+    "Store", "TCPStore", "FileStore", "ReduceOp",
 ]
