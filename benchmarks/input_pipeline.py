@@ -1,0 +1,107 @@
+"""Ladder-#5 input-pipeline benchmark: is the host loader faster than the
+chip?
+
+Measures (a) host-side loader throughput for the ImageNet augmentation
+pipeline (RandomResizedCrop + flip + normalize over SyntheticImageNet) at
+several ``num_workers``, and (b) the ResNet-50 bf16 fused-step throughput on
+the device, then reports the ratio.  loader/step >= 1 means the pipeline
+keeps the chip fed (the reference leans on pinned memory + 4 workers for
+the same property, /root/reference/example_mp.py:74-80).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def loader_images_per_sec(num_workers: int, batch: int = 128,
+                          n_images: int = 1024, image_size: int = 224,
+                          repeats: int = 3) -> float:
+    from tpu_dist.data import DataLoader, SyntheticImageNet, transforms
+
+    aug = transforms.Compose([
+        transforms.RandomResizedCrop(image_size),
+        transforms.RandomHorizontalFlip(),
+        transforms.Normalize(transforms.IMAGENET_MEAN,
+                             transforms.IMAGENET_STD),
+    ])
+    ds = SyntheticImageNet(train=True, n=n_images, image_size=image_size,
+                           num_classes=1000, transform=aug)
+    loader = DataLoader(ds, batch_size=batch, shuffle=True, drop_last=True,
+                        num_workers=num_workers)
+    # warm (allocators, page-in)
+    for _ in loader:
+        break
+    best = float("inf")
+    for ep in range(repeats):
+        loader.set_epoch(ep)
+        t0 = time.perf_counter()
+        seen = 0
+        for x, y in loader:
+            seen += len(x)
+        best = min(best, (time.perf_counter() - t0) / seen)
+    return 1.0 / best
+
+
+def device_step_images_per_sec(batch: int = 128,
+                               image_size: int = 224) -> float:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import tpu_dist.dist as dist
+    from tpu_dist import nn, optim
+    from tpu_dist.models import resnet50
+    from tpu_dist.parallel import DistributedDataParallel
+    from .timing import chained_step_time
+
+    own_group = not dist.is_initialized()
+    pg = dist.init_process_group() if own_group else dist.get_default_group()
+    n_chips = dist.get_world_size()
+    ddp = DistributedDataParallel(
+        resnet50(num_classes=1000),
+        optimizer=optim.SGD(lr=0.1, momentum=0.9, weight_decay=1e-4),
+        loss_fn=nn.CrossEntropyLoss(), group=pg, donate=True,
+        compute_dtype=jnp.bfloat16)
+    rng = np.random.default_rng(0)
+    sharding = NamedSharding(pg.mesh, P(pg.axis_name))
+    x = jax.device_put(
+        rng.normal(size=(batch * n_chips, image_size, image_size, 3))
+        .astype(np.float32), sharding)
+    y = jax.device_put(rng.integers(0, 1000, batch * n_chips).astype(np.int32),
+                       sharding)
+
+    def step(state):
+        new_state, m = ddp.train_step(state, x, y)
+        return new_state, m["loss"]
+
+    t = chained_step_time(step, lambda: ddp.init(seed=0), steps=20, reps=2)
+    if own_group:
+        dist.destroy_process_group()
+    return batch * n_chips / t
+
+
+def run(batch: int = 128, image_size: int = 224) -> dict:
+    loader = {w: round(loader_images_per_sec(w, batch=batch,
+                                             image_size=image_size), 1)
+              for w in (0, 2, 4, 8)}
+    step = device_step_images_per_sec(batch=batch, image_size=image_size)
+    best_loader = max(loader.values())
+    return {
+        "metric": "imagenet_input_pipeline_vs_resnet50_step",
+        "loader_images_per_sec": loader,
+        "resnet50_bf16_step_images_per_sec": round(step, 1),
+        "loader_over_step": round(best_loader / step, 2),
+        "loader_keeps_chip_fed": best_loader >= step,
+        "batch": batch,
+        "image_size": image_size,
+    }
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    print(json.dumps(run()))
