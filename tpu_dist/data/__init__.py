@@ -1,0 +1,25 @@
+"""tpu_dist.data — host-side data pipeline for TPU training.
+
+The L4 layer of SURVEY.md §1: distributed sampling, datasets, batched
+transforms, and prefetching device placement.  Replaces
+``torch.utils.data`` + torchvision in the reference scripts
+(/root/reference/mpspawn_dist.py:73-88, /root/reference/example_mp.py:56-80).
+"""
+
+from . import transforms
+from .datasets import (ArrayImageDataset, CIFAR10, Dataset, ImageFolder,
+                       MNIST, SyntheticImageNet, TensorDataset,
+                       synthetic_cifar10_arrays, synthetic_mnist_arrays)
+from .loader import DataLoader, DeviceLoader, default_collate
+from .sampler import (BatchSampler, DistributedSampler, RandomSampler,
+                      Sampler, SequentialSampler)
+
+__all__ = [
+    "transforms",
+    "Dataset", "TensorDataset", "ArrayImageDataset", "MNIST", "CIFAR10",
+    "ImageFolder", "SyntheticImageNet",
+    "synthetic_mnist_arrays", "synthetic_cifar10_arrays",
+    "DataLoader", "DeviceLoader", "default_collate",
+    "Sampler", "SequentialSampler", "RandomSampler", "BatchSampler",
+    "DistributedSampler",
+]

@@ -1,0 +1,405 @@
+"""Datasets: MNIST (IDX), CIFAR-10 (binary), ImageFolder, synthetic stand-ins.
+
+TPU-native counterpart of the torchvision datasets the reference downloads
+(MNIST at /root/reference/mpspawn_dist.py:73-74, CIFAR-10 at
+/root/reference/example_mp.py:60-69).  Differences by design:
+
+- Data is held as one contiguous uint8 NHWC array so the DataLoader can
+  materialize a whole per-host batch with a single fancy-index ``gather``
+  (vectorized; feeds the batched transforms in ``transforms.py``) instead of
+  assembling it sample-by-sample across worker processes.
+- Every dataset has a deterministic **synthetic fallback** so examples,
+  tests, and benches run hermetically in egress-less environments
+  (``synthetic_fallback=True``); the real readers parse the standard on-disk
+  formats (MNIST IDX, CIFAR-10 binary batches) when present.
+- ``download=True`` mirrors the reference's torchvision ``download=True``
+  (/root/reference/mpspawn_dist.py:74): fetch + checksum + extract into
+  ``root``, with a clear error naming the fallback when there is no egress.
+"""
+
+from __future__ import annotations
+
+import gzip
+import hashlib
+import os
+import struct
+import tarfile
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "Dataset", "TensorDataset", "ArrayImageDataset", "MNIST", "CIFAR10",
+    "ImageFolder", "SyntheticImageNet",
+    "synthetic_mnist_arrays", "synthetic_cifar10_arrays",
+]
+
+
+class Dataset:
+    """Abstract map-style dataset.  Subclasses may additionally provide
+    ``gather(indices) -> (batch_x, batch_y)`` to opt into the DataLoader's
+    vectorized batch path."""
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+    def __getitem__(self, index: int):
+        raise NotImplementedError
+
+
+class TensorDataset(Dataset):
+    """Tuple-of-arrays dataset (torch TensorDataset semantics)."""
+
+    def __init__(self, *arrays):
+        if not arrays:
+            raise ValueError("TensorDataset needs at least one array")
+        n = len(arrays[0])
+        for a in arrays[1:]:
+            if len(a) != n:
+                raise ValueError(
+                    f"size mismatch: {len(a)} vs {n} along dim 0")
+        self.arrays = arrays
+
+    def __len__(self):
+        return len(self.arrays[0])
+
+    def __getitem__(self, i):
+        return tuple(a[i] for a in self.arrays)
+
+
+class ArrayImageDataset(Dataset):
+    """(images, targets) held as whole arrays; vectorized ``gather``."""
+
+    def __init__(self, data: np.ndarray, targets: np.ndarray, transform=None):
+        if len(data) != len(targets):
+            raise ValueError(f"size mismatch: {len(data)} images vs "
+                             f"{len(targets)} targets")
+        self.data = data
+        self.targets = np.asarray(targets)
+        self.transform = transform
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, i):
+        return self.data[i], self.targets[i]
+
+    def gather(self, indices: np.ndarray):
+        return self.data[indices], self.targets[indices]
+
+
+# ---------------------------------------------------------------------------
+# synthetic stand-ins (deterministic; class-template + noise so models can
+# actually fit them — the loss-parity oracle and examples train on these)
+# ---------------------------------------------------------------------------
+
+def _synthetic_arrays(n: int, hw: Tuple[int, int], channels: int,
+                      num_classes: int, seed) -> Tuple[np.ndarray, np.ndarray]:
+    rng = np.random.default_rng(seed)
+    templates = rng.normal(128.0, 40.0, (num_classes, *hw, channels))
+    targets = rng.integers(0, num_classes, n)
+    noise = rng.standard_normal((n, *hw, channels), dtype=np.float32) * 32.0
+    data = np.clip(templates[targets] + noise, 0, 255).astype(np.uint8)
+    return data, targets.astype(np.int64)
+
+
+def synthetic_mnist_arrays(train: bool, n: Optional[int] = None):
+    """Deterministic MNIST-shaped data: (n, 28, 28, 1) uint8 + int64 labels."""
+    if n is None:
+        n = 60000 if train else 10000
+    return _synthetic_arrays(n, (28, 28), 1, 10, (0xDA7A, 0, int(train)))
+
+
+def synthetic_cifar10_arrays(train: bool, n: Optional[int] = None):
+    """Deterministic CIFAR-shaped data: (n, 32, 32, 3) uint8 + int64 labels."""
+    if n is None:
+        n = 50000 if train else 10000
+    return _synthetic_arrays(n, (32, 32), 3, 10, (0xDA7A, 1, int(train)))
+
+
+# ---------------------------------------------------------------------------
+# download machinery (reference parity: torchvision download=True)
+# ---------------------------------------------------------------------------
+
+def _download_file(url: str, dest: str, md5: Optional[str] = None) -> None:
+    import urllib.error
+    import urllib.request
+    os.makedirs(os.path.dirname(dest), exist_ok=True)
+    tmp = dest + ".part"
+    try:
+        with urllib.request.urlopen(url, timeout=60) as r, open(tmp, "wb") as f:
+            while True:
+                chunk = r.read(1 << 20)
+                if not chunk:
+                    break
+                f.write(chunk)
+    except (urllib.error.URLError, OSError) as e:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+        raise RuntimeError(
+            f"download of {url} failed ({e}); this environment may have no "
+            "network egress — place the files under the dataset root "
+            "manually, or construct the dataset with synthetic_fallback=True"
+        ) from e
+    if md5 is not None:
+        h = hashlib.md5()
+        with open(tmp, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        if h.hexdigest() != md5:
+            os.remove(tmp)
+            raise RuntimeError(f"checksum mismatch for {url}: "
+                               f"{h.hexdigest()} != {md5}")
+    os.replace(tmp, dest)
+
+
+_MNIST_FILES = (
+    # (gz name, md5 of gz) — mirrors torchvision's MNIST resource list
+    ("train-images-idx3-ubyte.gz", "f68b3c2dcbeaaa9fbdd348bbdeb94873"),
+    ("train-labels-idx1-ubyte.gz", "d53e105ee54ea40749a09fcbcd1e9432"),
+    ("t10k-images-idx3-ubyte.gz", "9fb629c4189551a2d022fa330f9573f3"),
+    ("t10k-labels-idx1-ubyte.gz", "ec29112dd5afa0611ce80d1b7f02629c"),
+)
+_MNIST_MIRROR = "https://storage.googleapis.com/cvdf-datasets/mnist/"
+
+_CIFAR10_ARCHIVE = "cifar-10-binary.tar.gz"
+_CIFAR10_URL = "https://www.cs.toronto.edu/~kriz/cifar-10-binary.tar.gz"
+_CIFAR10_MD5 = "c32a1d4ab5d03f1284b67883e8d87530"
+
+
+def _read_idx(path: str) -> np.ndarray:
+    """Parse an IDX-format file (the MNIST on-disk format)."""
+    with open(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        data = np.frombuffer(f.read(), np.uint8)
+    return data.reshape(dims)
+
+
+class MNIST(ArrayImageDataset):
+    """MNIST from IDX files at ``{root}/MNIST/raw/`` (NHWC uint8).
+
+    ``synthetic_fallback=True`` substitutes the deterministic synthetic set;
+    ``download=True`` fetches + gunzips the IDX files first (ref:
+    /root/reference/mpspawn_dist.py:74).
+    """
+
+    _raw_subdir = os.path.join("MNIST", "raw")
+
+    def __init__(self, root: str, train: bool = True, transform=None,
+                 synthetic_fallback: Optional[bool] = None,
+                 download: bool = False):
+        self.root = root
+        self.train = train
+        if synthetic_fallback:
+            data, targets = self._synthetic(train)
+        else:
+            if download:
+                self._download(root)
+            try:
+                data, targets = self._load(root, train)
+            except FileNotFoundError as e:
+                raise FileNotFoundError(
+                    f"{e}; pass download=True to fetch it, or "
+                    f"synthetic_fallback=True to use the deterministic "
+                    f"SYNTHETIC stand-in") from e
+        super().__init__(data, targets, transform=transform)
+
+    @staticmethod
+    def _synthetic(train):
+        return synthetic_mnist_arrays(train)
+
+    def _filenames(self, train: bool):
+        p = "train" if train else "t10k"
+        return f"{p}-images-idx3-ubyte", f"{p}-labels-idx1-ubyte"
+
+    def _load(self, root, train):
+        raw = os.path.join(root, self._raw_subdir)
+        img_f, lbl_f = self._filenames(train)
+        img_p, lbl_p = os.path.join(raw, img_f), os.path.join(raw, lbl_f)
+        for p in (img_p, lbl_p):
+            if not os.path.exists(p):
+                raise FileNotFoundError(f"missing dataset file {p}")
+        imgs = _read_idx(img_p)
+        lbls = _read_idx(lbl_p)
+        return imgs[..., None], lbls.astype(np.int64)
+
+    def _download(self, root):
+        raw = os.path.join(root, self._raw_subdir)
+        for gz_name, md5 in _MNIST_FILES:
+            out = os.path.join(raw, gz_name[:-3])
+            if os.path.exists(out):
+                continue
+            gz_path = os.path.join(raw, gz_name)
+            if not os.path.exists(gz_path):
+                _download_file(_MNIST_MIRROR + gz_name, gz_path, md5)
+            with gzip.open(gz_path, "rb") as f_in, open(out, "wb") as f_out:
+                f_out.write(f_in.read())
+
+
+class CIFAR10(ArrayImageDataset):
+    """CIFAR-10 from the binary batches at ``{root}/cifar-10-batches-bin/``.
+
+    Record format: 1 label byte + 3×32×32 planar RGB; converted to NHWC.
+    Normalization constants live in ``transforms`` (ref constants at
+    /root/reference/example_mp.py:65-67).
+    """
+
+    _bin_subdir = "cifar-10-batches-bin"
+
+    def __init__(self, root: str, train: bool = True, transform=None,
+                 synthetic_fallback: Optional[bool] = None,
+                 download: bool = False):
+        self.root = root
+        self.train = train
+        if synthetic_fallback:
+            data, targets = synthetic_cifar10_arrays(train)
+        else:
+            if download:
+                self._download(root)
+            try:
+                data, targets = self._load(root, train)
+            except FileNotFoundError as e:
+                raise FileNotFoundError(
+                    f"{e}; pass download=True to fetch it, or "
+                    f"synthetic_fallback=True to use the deterministic "
+                    f"SYNTHETIC stand-in") from e
+        super().__init__(data, targets, transform=transform)
+
+    def _load(self, root, train):
+        d = os.path.join(root, self._bin_subdir)
+        names = ([f"data_batch_{i}.bin" for i in range(1, 6)] if train
+                 else ["test_batch.bin"])
+        imgs, lbls = [], []
+        for name in names:
+            p = os.path.join(d, name)
+            if not os.path.exists(p):
+                raise FileNotFoundError(f"missing dataset file {p}")
+            rec = np.fromfile(p, np.uint8).reshape(-1, 3073)
+            lbls.append(rec[:, 0])
+            imgs.append(rec[:, 1:].reshape(-1, 3, 32, 32)
+                        .transpose(0, 2, 3, 1))
+        return (np.ascontiguousarray(np.concatenate(imgs)),
+                np.concatenate(lbls).astype(np.int64))
+
+    def _download(self, root):
+        d = os.path.join(root, self._bin_subdir)
+        if os.path.exists(os.path.join(d, "data_batch_1.bin")):
+            return
+        archive = os.path.join(root, _CIFAR10_ARCHIVE)
+        if not os.path.exists(archive):
+            _download_file(_CIFAR10_URL, archive, _CIFAR10_MD5)
+        with tarfile.open(archive, "r:gz") as tf:
+            tf.extractall(root)
+
+
+class ImageFolder(Dataset):
+    """Directory-of-class-subdirs dataset (torchvision ImageFolder layout).
+
+    Accepts ``.npy`` (HWC uint8) files natively and standard image formats
+    when PIL is importable.  ``sample_size=(h, w)`` resizes every image at
+    load time so batches stack uniformly for the vectorized gather path.
+    """
+
+    _IMG_EXT = (".npy", ".png", ".jpg", ".jpeg", ".bmp", ".ppm")
+
+    def __init__(self, root: str, transform=None,
+                 sample_size: Optional[Tuple[int, int]] = None):
+        self.root = root
+        self.transform = transform
+        self.sample_size = sample_size
+        self.classes = sorted(e.name for e in os.scandir(root) if e.is_dir())
+        if not self.classes:
+            raise FileNotFoundError(f"no class subdirectories under {root}")
+        self.class_to_idx = {c: i for i, c in enumerate(self.classes)}
+        self.samples = []
+        for c in self.classes:
+            cdir = os.path.join(root, c)
+            for name in sorted(os.listdir(cdir)):
+                if name.lower().endswith(self._IMG_EXT):
+                    self.samples.append((os.path.join(cdir, name),
+                                         self.class_to_idx[c]))
+        if not self.samples:
+            raise FileNotFoundError(f"no images found under {root} "
+                                    f"(extensions: {self._IMG_EXT})")
+        self.targets = np.asarray([y for _, y in self.samples], np.int64)
+
+    def __len__(self):
+        return len(self.samples)
+
+    def _load(self, path: str) -> np.ndarray:
+        if path.endswith(".npy"):
+            arr = np.load(path)
+        else:
+            try:
+                from PIL import Image
+            except ImportError as e:
+                raise RuntimeError(
+                    f"decoding {path} requires PIL; convert images to .npy "
+                    "(HWC uint8) for the PIL-free path") from e
+            arr = np.asarray(Image.open(path).convert("RGB"))
+        if arr.ndim == 2:
+            arr = arr[..., None]
+        if self.sample_size and arr.shape[:2] != tuple(self.sample_size):
+            from .transforms import Resize
+            arr = Resize(self.sample_size)(arr[None].astype(np.float32))[0]
+            arr = np.clip(arr, 0, 255).astype(np.uint8)
+        return arr
+
+    def __getitem__(self, i):
+        path, y = self.samples[i]
+        return self._load(path), y
+
+    def gather(self, indices: np.ndarray):
+        xs = [self._load(self.samples[int(i)][0]) for i in indices]
+        return np.stack(xs), self.targets[indices]
+
+
+class SyntheticImageNet(Dataset):
+    """Deterministic ImageNet-scale stand-in: ``n`` images of
+    ``image_size²×3`` built lazily (per-class low-res template upsampled +
+    per-index noise) so huge configs don't hold the whole set in RAM.
+    Used by the ladder-#5 example/bench (BASELINE.md) where the real
+    ImageNet cannot be shipped.
+    """
+
+    _TPL = 16  # low-res template edge
+
+    def __init__(self, train: bool = True, n: int = 1024,
+                 image_size: int = 224, num_classes: int = 1000,
+                 transform=None, seed: int = 0xA1A):
+        self.n = n
+        self.image_size = image_size
+        self.num_classes = num_classes
+        self.transform = transform
+        self._seed = (seed, int(train))
+        rng = np.random.default_rng(self._seed)
+        self._templates = rng.normal(
+            128.0, 45.0, (num_classes, self._TPL, self._TPL, 3)
+        ).astype(np.float32)
+        self.targets = rng.integers(0, num_classes, n).astype(np.int64)
+
+    def __len__(self):
+        return self.n
+
+    def _upsampled(self, classes: np.ndarray) -> np.ndarray:
+        k = -(-self.image_size // self._TPL)
+        t = self._templates[classes]
+        t = np.repeat(np.repeat(t, k, axis=1), k, axis=2)
+        return t[:, :self.image_size, :self.image_size, :]
+
+    def gather(self, indices: np.ndarray):
+        indices = np.asarray(indices, np.int64)
+        base = self._upsampled(self.targets[indices])
+        s = self.image_size
+        out = np.empty((len(indices), s, s, 3), np.uint8)
+        for k, i in enumerate(indices):
+            r = np.random.default_rng((*self._seed, int(i)))
+            noise = r.standard_normal((s, s, 3), dtype=np.float32) * 25.0
+            out[k] = np.clip(base[k] + noise, 0, 255)
+        return out, self.targets[indices]
+
+    def __getitem__(self, i):
+        x, y = self.gather(np.asarray([i]))
+        return x[0], y[0]

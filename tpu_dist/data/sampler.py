@@ -1,0 +1,172 @@
+"""Index samplers — host-side data sharding for the TPU data pipeline.
+
+TPU-native counterpart of ``torch.utils.data``'s sampler family, most
+importantly ``DistributedSampler`` (ref: consumed at
+/root/reference/mpspawn_dist.py:77-81, /root/reference/example_mp.py:73,
+/root/reference/launch_dist.py:67-71).  The semantics are torch-exact where
+they are observable (verified against torch in tests/test_sampler.py):
+
+- the dataset is padded by repeating leading indices until the total is
+  divisible by ``num_replicas`` (or truncated when ``drop_last=True``),
+- rank ``r`` takes the strided slice ``indices[r::num_replicas]``,
+- ``set_epoch(e)`` reseeds the permutation so every rank agrees on the
+  epoch-``e`` shuffle (ref: /root/reference/example_mp.py:100).
+
+The shuffle PRNG is numpy's (seeded ``(seed, epoch)``) rather than torch's
+``randperm`` — the partition structure is identical, the permutation itself
+differs by design.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+__all__ = [
+    "Sampler",
+    "SequentialSampler",
+    "RandomSampler",
+    "BatchSampler",
+    "DistributedSampler",
+]
+
+
+class Sampler:
+    """Abstract iterable over dataset indices."""
+
+    def __iter__(self) -> Iterator[int]:
+        raise NotImplementedError
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+    def set_epoch(self, epoch: int) -> None:  # no-op for deterministic samplers
+        """Advance the epoch counter (reshuffles stochastic samplers)."""
+
+
+class SequentialSampler(Sampler):
+    """Yields ``0..len(dataset)-1`` in order."""
+
+    def __init__(self, dataset):
+        self.dataset = dataset
+
+    def __iter__(self):
+        return iter(range(len(self.dataset)))
+
+    def __len__(self):
+        return len(self.dataset)
+
+
+class RandomSampler(Sampler):
+    """Epoch-seeded permutation of the dataset (deterministic per epoch)."""
+
+    def __init__(self, dataset, seed: int = 0):
+        self.dataset = dataset
+        self.seed = seed
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __iter__(self):
+        rng = np.random.default_rng((self.seed, self.epoch))
+        return iter(rng.permutation(len(self.dataset)).tolist())
+
+    def __len__(self):
+        return len(self.dataset)
+
+
+class BatchSampler(Sampler):
+    """Chunks a sampler's index stream into lists of ``batch_size``."""
+
+    def __init__(self, sampler: Sampler, batch_size: int, drop_last: bool):
+        if batch_size <= 0:
+            raise ValueError(f"batch_size must be positive, got {batch_size}")
+        self.sampler = sampler
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+
+    def __iter__(self) -> Iterator[List[int]]:
+        batch: List[int] = []
+        for idx in self.sampler:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = len(self.sampler)
+        if self.drop_last:
+            return n // self.batch_size
+        return -(-n // self.batch_size)
+
+    def set_epoch(self, epoch: int) -> None:
+        self.sampler.set_epoch(epoch)
+
+
+class DistributedSampler(Sampler):
+    """Shards a dataset across ``num_replicas`` data-loading processes.
+
+    Defaults derive from the active process group: one shard per *process*
+    (each process feeds all its local TPU devices with one global batch that
+    ``DeviceLoader`` splits over the mesh's data axis), matching the
+    reference's one-shard-per-GPU-process layout.
+    """
+
+    def __init__(self, dataset, num_replicas: Optional[int] = None,
+                 rank: Optional[int] = None, shuffle: bool = True,
+                 seed: int = 0, drop_last: bool = False):
+        if num_replicas is None or rank is None:
+            import tpu_dist.dist as dist
+            if num_replicas is None:
+                num_replicas = (dist.get_num_processes()
+                                if dist.is_initialized() else 1)
+            if rank is None:
+                rank = dist.get_rank() if dist.is_initialized() else 0
+        if not 0 <= rank < num_replicas:
+            raise ValueError(
+                f"rank must be in [0, {num_replicas}), got rank={rank}")
+        self.dataset = dataset
+        self.num_replicas = num_replicas
+        self.rank = rank
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+        n = len(dataset)
+        # torch-exact shard sizing (tests/test_sampler.py::TestTorchParity)
+        if self.drop_last and n % num_replicas != 0:
+            self.num_samples = math.ceil((n - num_replicas) / num_replicas)
+        else:
+            self.num_samples = math.ceil(n / num_replicas)
+        self.total_size = self.num_samples * num_replicas
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __iter__(self):
+        n = len(self.dataset)
+        if self.shuffle:
+            rng = np.random.default_rng((self.seed, self.epoch))
+            indices = rng.permutation(n).tolist()
+        else:
+            indices = list(range(n))
+        if self.drop_last:
+            indices = indices[:self.total_size]
+        else:
+            padding = self.total_size - len(indices)
+            if padding > 0:
+                if padding <= len(indices):
+                    indices += indices[:padding]
+                else:
+                    reps = math.ceil(padding / len(indices))
+                    indices += (indices * reps)[:padding]
+        assert len(indices) == self.total_size
+        return iter(indices[self.rank:self.total_size:self.num_replicas])
+
+    def __len__(self):
+        return self.num_samples

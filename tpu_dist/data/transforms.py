@@ -1,0 +1,241 @@
+"""Batched host-side image transforms (NHWC, numpy).
+
+TPU-native replacement for the torchvision transform pipeline the reference
+uses (ToTensor/Normalize at /root/reference/mpspawn_dist.py:73-74,
+RandomCrop(32, padding=4) + RandomHorizontalFlip at
+/root/reference/example_mp.py:60-69).  Design differences, deliberately:
+
+- Transforms are **batched**: they take ``(N, H, W, C)`` arrays and vectorize
+  the per-image randomness (per-image crop offsets / flip masks drawn in one
+  numpy call), because the TPU input pipeline materializes whole per-host
+  batches at once instead of decoding one sample per worker process.
+- Randomness is **explicit**: stochastic transforms take a
+  ``numpy.random.Generator`` and raise without one.  The DataLoader derives
+  the stream from ``(seed, rank, epoch, batch)`` so augmentation differs per
+  rank and per epoch while staying reproducible (SURVEY.md §7 per-replica
+  RNG hard part).
+- Layout is NHWC (TPU-friendly; conv layers in ``tpu_dist.nn`` are NHWC) and
+  images are float32 in [0, 1] after ``ToFloat`` — the torch ``ToTensor``
+  scaling without the CHW permute.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+__all__ = [
+    "Transform", "Compose", "ToFloat", "Normalize", "RandomCrop",
+    "RandomHorizontalFlip", "RandomResizedCrop", "Resize", "CenterCrop",
+    "MNIST_MEAN", "MNIST_STD", "CIFAR10_MEAN", "CIFAR10_STD",
+    "IMAGENET_MEAN", "IMAGENET_STD",
+]
+
+# Reference normalization constants (/root/reference/mpspawn_dist.py:73,
+# /root/reference/example_mp.py:65-67); ImageNet's are the standard ones.
+MNIST_MEAN = (0.1307,)
+MNIST_STD = (0.3081,)
+CIFAR10_MEAN = (0.4914, 0.4822, 0.4465)
+CIFAR10_STD = (0.2023, 0.1994, 0.2010)
+IMAGENET_MEAN = (0.485, 0.456, 0.406)
+IMAGENET_STD = (0.229, 0.224, 0.225)
+
+_Size = Union[int, Tuple[int, int]]
+
+
+def _pair(size: _Size) -> Tuple[int, int]:
+    if isinstance(size, int):
+        return (size, size)
+    return (int(size[0]), int(size[1]))
+
+
+class Transform:
+    """Base: callable on a batched NHWC array, optional RNG stream."""
+
+    def __call__(self, x: np.ndarray,
+                 rng: Optional[np.random.Generator] = None) -> np.ndarray:
+        raise NotImplementedError
+
+    def _require_rng(self, rng):
+        if rng is None:
+            raise ValueError(
+                f"{type(self).__name__} is stochastic and requires an rng "
+                "(numpy.random.Generator); the DataLoader supplies one "
+                "per (rank, epoch, batch)")
+        return rng
+
+
+class Compose(Transform):
+    def __init__(self, transforms: Sequence[Transform]):
+        self.transforms = list(transforms)
+
+    def __call__(self, x, rng=None):
+        for t in self.transforms:
+            x = t(x, rng)
+        return x
+
+    def __repr__(self):
+        return f"Compose({self.transforms!r})"
+
+
+class ToFloat(Transform):
+    """uint8 [0,255] → float32 [0,1] (torch ToTensor scaling, NHWC kept)."""
+
+    def __call__(self, x, rng=None):
+        if x.dtype == np.uint8:
+            return x.astype(np.float32) / 255.0
+        return np.asarray(x, np.float32)
+
+
+class Normalize(Transform):
+    """Channel-wise ``(x - mean) / std`` over the trailing C axis."""
+
+    def __init__(self, mean: Sequence[float], std: Sequence[float]):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        if np.any(self.std == 0):
+            raise ValueError("std must be non-zero in every channel")
+
+    def __call__(self, x, rng=None):
+        return (np.asarray(x, np.float32) - self.mean) / self.std
+
+
+class RandomCrop(Transform):
+    """Zero-pad by ``padding`` then crop a random ``size`` window per image.
+
+    Ref semantics: torchvision RandomCrop(32, padding=4)
+    (/root/reference/example_mp.py:62) — but vectorized: every image in the
+    batch draws an independent offset from the shared rng.
+    """
+
+    def __init__(self, size: _Size, padding: int = 0):
+        self.size = _pair(size)
+        self.padding = int(padding)
+
+    def __call__(self, x, rng=None):
+        rng = self._require_rng(rng)
+        n, h, w, _ = x.shape
+        p = self.padding
+        th, tw = self.size
+        if p:
+            x = np.pad(x, ((0, 0), (p, p), (p, p), (0, 0)))
+            h, w = h + 2 * p, w + 2 * p
+        if th > h or tw > w:
+            raise ValueError(f"crop {self.size} larger than padded input "
+                             f"({h}, {w})")
+        top = rng.integers(0, h - th + 1, size=n)
+        left = rng.integers(0, w - tw + 1, size=n)
+        rows = top[:, None] + np.arange(th)[None, :]          # (N, th)
+        cols = left[:, None] + np.arange(tw)[None, :]         # (N, tw)
+        bidx = np.arange(n)[:, None, None]
+        return x[bidx, rows[:, :, None], cols[:, None, :]]    # (N, th, tw, C)
+
+
+class RandomHorizontalFlip(Transform):
+    """Flip each image left-right independently with probability ``p``."""
+
+    def __init__(self, p: float = 0.5):
+        self.p = float(p)
+
+    def __call__(self, x, rng=None):
+        if self.p <= 0.0:
+            return x
+        flipped = x[:, :, ::-1, :]
+        if self.p >= 1.0:
+            return flipped
+        rng = self._require_rng(rng)
+        mask = rng.random(x.shape[0]) < self.p
+        return np.where(mask[:, None, None, None], flipped, x)
+
+
+def _bilinear_crop_resize(x: np.ndarray, top: np.ndarray, left: np.ndarray,
+                          crop_h: np.ndarray, crop_w: np.ndarray,
+                          out_hw: Tuple[int, int]) -> np.ndarray:
+    """Resample per-image boxes ``(top, left, crop_h, crop_w)`` to ``out_hw``
+    with bilinear interpolation, fully vectorized over the batch."""
+    x = np.asarray(x, np.float32)
+    n, h, w, _ = x.shape
+    oh, ow = out_hw
+    # half-pixel-centered source coordinates, per image
+    ys = (top[:, None] + (np.arange(oh, dtype=np.float32)[None, :] + 0.5)
+          * (crop_h[:, None] / oh) - 0.5)                       # (N, oh)
+    xs = (left[:, None] + (np.arange(ow, dtype=np.float32)[None, :] + 0.5)
+          * (crop_w[:, None] / ow) - 0.5)                       # (N, ow)
+    ys = np.clip(ys, 0.0, h - 1.0)
+    xs = np.clip(xs, 0.0, w - 1.0)
+    y0 = np.floor(ys).astype(np.int64)
+    x0 = np.floor(xs).astype(np.int64)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    wy = (ys - y0).astype(np.float32)[:, :, None, None]         # (N, oh, 1, 1)
+    wx = (xs - x0).astype(np.float32)[:, None, :, None]         # (N, 1, ow, 1)
+    b = np.arange(n)[:, None, None]
+    g = lambda yi, xi: x[b, yi[:, :, None], xi[:, None, :]]     # (N,oh,ow,C)
+    top_row = g(y0, x0) * (1 - wx) + g(y0, x1) * wx
+    bot_row = g(y1, x0) * (1 - wx) + g(y1, x1) * wx
+    return top_row * (1 - wy) + bot_row * wy
+
+
+class RandomResizedCrop(Transform):
+    """Random scale/aspect crop resized to ``size`` (torchvision semantics:
+    area in ``scale``·A, log-uniform aspect in ``ratio``; falls back to a
+    center crop when the draw doesn't fit).  One vectorized draw per image."""
+
+    def __init__(self, size: _Size, scale=(0.08, 1.0),
+                 ratio=(3.0 / 4.0, 4.0 / 3.0)):
+        self.size = _pair(size)
+        self.scale = scale
+        self.ratio = ratio
+
+    def __call__(self, x, rng=None):
+        rng = self._require_rng(rng)
+        n, h, w, _ = x.shape
+        area = h * w
+        target = area * rng.uniform(self.scale[0], self.scale[1], n)
+        aspect = np.exp(rng.uniform(np.log(self.ratio[0]),
+                                    np.log(self.ratio[1]), n))
+        cw = np.sqrt(target * aspect)
+        ch = np.sqrt(target / aspect)
+        # clamp infeasible draws to a centered max-size box (the torchvision
+        # "fallback" path, applied per image instead of via 10 retries)
+        bad = (cw > w) | (ch > h)
+        shrink = np.minimum(w / np.maximum(cw, 1e-6),
+                            h / np.maximum(ch, 1e-6))
+        cw = np.where(bad, cw * shrink, cw)
+        ch = np.where(bad, ch * shrink, ch)
+        top = rng.uniform(0, 1, n) * (h - ch)
+        left = rng.uniform(0, 1, n) * (w - cw)
+        return _bilinear_crop_resize(x, top.astype(np.float32),
+                                     left.astype(np.float32),
+                                     ch.astype(np.float32),
+                                     cw.astype(np.float32), self.size)
+
+
+class Resize(Transform):
+    """Bilinear resize of the full image to ``size`` (int → square)."""
+
+    def __init__(self, size: _Size):
+        self.size = _pair(size)
+
+    def __call__(self, x, rng=None):
+        n, h, w, _ = x.shape
+        if (h, w) == self.size:
+            return np.asarray(x, np.float32)
+        z = np.zeros(n, np.float32)
+        return _bilinear_crop_resize(x, z, z, np.full(n, h, np.float32),
+                                     np.full(n, w, np.float32), self.size)
+
+
+class CenterCrop(Transform):
+    def __init__(self, size: _Size):
+        self.size = _pair(size)
+
+    def __call__(self, x, rng=None):
+        _, h, w, _ = x.shape
+        th, tw = self.size
+        if th > h or tw > w:
+            raise ValueError(f"crop {self.size} larger than input ({h}, {w})")
+        i = (h - th) // 2
+        j = (w - tw) // 2
+        return x[:, i:i + th, j:j + tw, :]
