@@ -1,0 +1,89 @@
+"""Flash-attention kernel vs XLA's dense path on the real chip.
+
+The long-context microbenchmark (no reference counterpart — the reference
+has no attention; SURVEY.md §5 long-context row).  Times causal self-
+attention forward+backward at transformer-block shapes through both
+implementations of tpu_dist.nn.attention.scaled_dot_product_attention:
+
+  dense  — materialized (T, T) scores, XLA-fused softmax
+  flash  — tpu_dist.ops.flash_attention (Pallas, O(T) memory)
+
+and reports achieved model TFLOP/s (4*B*H*T^2*D fwd, 2.5x with bwd; the
+causal factor-of-2 saving is NOT credited — standard flash accounting) plus
+the flash:dense speedup.  Long sequences where dense's scores no longer fit
+are flash-only rows (that's the point of the kernel).
+"""
+
+from __future__ import annotations
+
+import math
+import time
+
+
+def _time_fn(fn, args, reps: int = 3, iters: int = 10) -> float:
+    """Min-of-reps seconds per call; tunnel-safe single readback."""
+    import jax.numpy as jnp
+
+    out = fn(*args)
+    float(jnp.sum(out[0] if isinstance(out, tuple) else out))  # compile+warm
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        acc = None
+        for _ in range(iters):
+            out = fn(*args)
+            acc = out[0] if isinstance(out, tuple) else out
+        float(jnp.sum(acc))
+        times.append((time.perf_counter() - t0) / iters)
+    return min(times)
+
+
+def run(b: int = 4, h: int = 8, d: int = 64) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_dist.nn.attention import scaled_dot_product_attention as sdpa
+
+    rng = np.random.default_rng(0)
+    rows = []
+    for t, both in ((2048, True), (8192, False)):
+        q, k, v = (jnp.asarray(rng.standard_normal((b, t, h, d)),
+                               jnp.bfloat16) for _ in range(3))
+
+        def train_step(q, k, v, impl):
+            def loss(q, k, v):
+                o = sdpa(q, k, v, causal=True, impl=impl)
+                return jnp.sum(o.astype(jnp.float32) ** 2)
+            return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+        flops_fwd = 4 * b * h * t * t * d
+        row = {"seq_len": t}
+        for impl in ("flash", "dense") if both else ("flash",):
+            fwd = jax.jit(lambda q, k, v, i=impl: sdpa(
+                q, k, v, causal=True, impl=i))
+            bwd = jax.jit(lambda q, k, v, i=impl: train_step(q, k, v, i))
+            t_f = _time_fn(fwd, (q, k, v))
+            t_b = _time_fn(bwd, (q, k, v))
+            row[impl] = {
+                "fwd_ms": round(t_f * 1e3, 3),
+                "fwd_bwd_ms": round(t_b * 1e3, 3),
+                "fwd_tflops": round(flops_fwd / t_f / 1e12, 2),
+                "fwd_bwd_tflops": round(2.5 * flops_fwd / t_b / 1e12, 2),
+            }
+        if both:
+            row["flash_speedup_fwd_bwd"] = round(
+                row["dense"]["fwd_bwd_ms"] / row["flash"]["fwd_bwd_ms"], 3)
+        rows.append(row)
+
+    return {
+        "metric": "flash_attention_causal_bf16",
+        "shape": {"batch": b, "heads": h, "head_dim": d},
+        "rows": rows,
+    }
+
+
+if __name__ == "__main__":
+    import json
+
+    print(json.dumps(run()))

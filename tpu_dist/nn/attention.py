@@ -28,11 +28,32 @@ __all__ = ["scaled_dot_product_attention", "MultiheadSelfAttention"]
 
 
 def scaled_dot_product_attention(q, k, v, causal: bool = False,
-                                 mask: Optional[jax.Array] = None):
-    """Dense attention.  ``q,k,v``: (..., T, H, D) → (..., T, H, D).
+                                 mask: Optional[jax.Array] = None,
+                                 impl: Optional[str] = None):
+    """Attention.  ``q,k,v``: (..., T, H, D) → (..., T, H, D).
 
     ``mask``: broadcastable to (..., H, Tq, Tk), True = keep.
+
+    ``impl``: ``"dense"`` materializes the (Tq, Tk) scores (supports
+    arbitrary masks); ``"flash"`` runs the O(T)-memory Pallas kernel
+    (tpu_dist.ops.flash_attention; causal/no-mask only).  Default (None /
+    ``"auto"``): flash on TPU backends when no arbitrary mask is given,
+    dense elsewhere (the kernel runs interpreted off-TPU — correct but
+    slower than XLA's fused dense path).
     """
+    if impl in (None, "auto"):
+        flash_ok = (mask is None and jax.default_backend() == "tpu"
+                    and q.shape[:-3] == k.shape[:-3] == v.shape[:-3]
+                    and k.shape == v.shape)  # no broadcast-KV in the kernel
+        impl = "flash" if flash_ok else "dense"
+    if impl == "flash":
+        if mask is not None:
+            raise ValueError("impl='flash' supports causal masking only; "
+                             "pass impl='dense' for arbitrary masks")
+        from ..ops.flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=causal)
+    if impl != "dense":
+        raise ValueError(f"Unknown attention impl {impl!r}")
     d = q.shape[-1]
     # (..., H, Tq, Tk)
     scores = jnp.einsum("...qhd,...khd->...hqk", q, k) / math.sqrt(d)
@@ -59,7 +80,7 @@ class MultiheadSelfAttention(Module):
 
     def __init__(self, embed_dim: int, num_heads: int, bias: bool = True,
                  causal: bool = False, sequence_axis: Optional[str] = None,
-                 mode: str = "ring"):
+                 mode: str = "ring", attn_impl: Optional[str] = None):
         super().__init__()
         if embed_dim % num_heads:
             raise ValueError(f"embed_dim {embed_dim} not divisible by "
@@ -73,6 +94,7 @@ class MultiheadSelfAttention(Module):
         self.causal = causal
         self.sequence_axis = sequence_axis
         self.mode = mode
+        self.attn_impl = attn_impl  # None=auto | "dense" | "flash"
 
     def create_params(self, key):
         k1, k2 = jax.random.split(key)
@@ -100,7 +122,8 @@ class MultiheadSelfAttention(Module):
             out = fn(q, k, v, axis_name=self.sequence_axis,
                      causal=self.causal)
         else:
-            out = scaled_dot_product_attention(q, k, v, causal=self.causal)
+            out = scaled_dot_product_attention(q, k, v, causal=self.causal,
+                                               impl=self.attn_impl)
         out = out.reshape(b, t, self.embed_dim)
         return F.linear(out, p["out_weight"], p.get("out_bias"))
 

@@ -5,5 +5,6 @@ them from XLA, and this package holds the hand-written Pallas kernels for the
 cases worth owning: ops where fusion XLA can't see saves HBM traffic."""
 
 from .cross_entropy import fused_cross_entropy
+from .flash_attention import flash_attention
 
-__all__ = ["fused_cross_entropy"]
+__all__ = ["fused_cross_entropy", "flash_attention"]
