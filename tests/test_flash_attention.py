@@ -98,6 +98,35 @@ def test_sdpa_impl_flash_dispatch(rng):
         scaled_dot_product_attention(q, k, v, mask=mask, impl="flash")
 
 
+def test_flash_under_shard_map(rng, eight_devices):
+    # the DDP-wrapper path: pallas_call traced inside shard_map requires
+    # vma-annotated out_shapes (regression test for the _out_struct fix)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = jax.make_mesh((8,), ("data",))
+    q, k, v = _rand_qkv(rng, 16, 64, 64, 2, 32)
+
+    def local_loss(q, k, v):
+        o = flash_attention(q, k, v, causal=True)
+        return jax.lax.pmean(jnp.sum(o ** 2), "data")
+
+    loss_fn = jax.jit(jax.shard_map(
+        lambda q, k, v: jax.value_and_grad(local_loss)(q, k, v),
+        mesh=mesh, in_specs=(P("data"),) * 3,
+        out_specs=(P(), P("data"))))
+    sh = NamedSharding(mesh, P("data"))
+    loss, dq = loss_fn(*(jax.device_put(x, sh) for x in (q, k, v)))
+
+    ref_loss, ref_dq = jax.value_and_grad(
+        lambda q: jnp.mean(jnp.sum(
+            scaled_dot_product_attention(q, k, v, causal=True) ** 2,
+            axis=(1, 2, 3))))(q)
+    np.testing.assert_allclose(float(loss), float(ref_loss) * 16 / 8,
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(dq), np.asarray(ref_dq) * 2,
+                               atol=1e-4, rtol=1e-4)
+
+
 def test_broadcast_kv_rejected(rng):
     # numpy-broadcast batch dims (shared KV) would silently misalign the
     # (B*H, T, D) flatten — must raise, and auto-dispatch must go dense

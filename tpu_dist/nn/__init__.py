@@ -2,7 +2,8 @@
 SURVEY.md §1)."""
 
 from . import functional, init
-from .attention import MultiheadSelfAttention, scaled_dot_product_attention
+from .attention import (MultiheadSelfAttention, attention_impl,
+                        scaled_dot_product_attention)
 from .layers import (AdaptiveAvgPool2d, AvgPool2d, BatchNorm2d, Conv2d,
                      Dropout, Embedding, Flatten, GELU, Identity, LayerNorm,
                      Linear, MaxPool2d, ReLU)
@@ -15,5 +16,6 @@ __all__ = [
     "ReLU", "Flatten", "Dropout", "BatchNorm2d", "Identity",
     "Embedding", "LayerNorm", "GELU",
     "MultiheadSelfAttention", "scaled_dot_product_attention",
+    "attention_impl",
     "CrossEntropyLoss",
 ]

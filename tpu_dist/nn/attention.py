@@ -14,6 +14,7 @@ variants live in the parallel package where the blocking crosses devices).
 
 from __future__ import annotations
 
+import contextlib
 import math
 from typing import Optional
 
@@ -24,7 +25,26 @@ from . import functional as F
 from .module import Module
 from . import init as I
 
-__all__ = ["scaled_dot_product_attention", "MultiheadSelfAttention"]
+__all__ = ["scaled_dot_product_attention", "MultiheadSelfAttention",
+           "attention_impl"]
+
+_IMPL_OVERRIDE: list = []
+
+
+@contextlib.contextmanager
+def attention_impl(impl: str):
+    """Trace-scoped default for :func:`scaled_dot_product_attention`'s
+    ``impl`` — overrides the auto choice for every attention call traced
+    inside the block (explicit per-call ``impl=`` still wins).  Used by
+    ``make_gspmd_train_step`` to force ``"dense"``: a Pallas custom call
+    can't be cut by XLA's SPMD partitioner, so under GSPMD-sharded jit the
+    flash kernel must not be auto-dispatched (inside ``shard_map`` — the
+    DDP and ring-attention paths — per-device flash is fine and used)."""
+    _IMPL_OVERRIDE.append(impl)
+    try:
+        yield
+    finally:
+        _IMPL_OVERRIDE.pop()
 
 
 def scaled_dot_product_attention(q, k, v, causal: bool = False,
@@ -42,10 +62,13 @@ def scaled_dot_product_attention(q, k, v, causal: bool = False,
     slower than XLA's fused dense path).
     """
     if impl in (None, "auto"):
-        flash_ok = (mask is None and jax.default_backend() == "tpu"
-                    and q.shape[:-3] == k.shape[:-3] == v.shape[:-3]
-                    and k.shape == v.shape)  # no broadcast-KV in the kernel
-        impl = "flash" if flash_ok else "dense"
+        if _IMPL_OVERRIDE:
+            impl = _IMPL_OVERRIDE[-1]
+        else:
+            flash_ok = (mask is None and jax.default_backend() == "tpu"
+                        and q.shape[:-3] == k.shape[:-3] == v.shape[:-3]
+                        and k.shape == v.shape)  # no broadcast-KV kernel path
+            impl = "flash" if flash_ok else "dense"
     if impl == "flash":
         if mask is not None:
             raise ValueError("impl='flash' supports causal masking only; "

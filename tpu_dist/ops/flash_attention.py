@@ -53,9 +53,45 @@ def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _out_struct(shape, dtype, *operands):
+    """ShapeDtypeStruct whose varying-mesh-axes set is the union of the
+    operands' — required for pallas_call outputs traced inside shard_map
+    (e.g. under the DDP wrapper), harmless outside it."""
+    try:
+        vma = frozenset().union(*(jax.typeof(x).vma for x in operands))
+    except (AttributeError, TypeError):
+        return jax.ShapeDtypeStruct(shape, dtype)
+    return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
+
+def _masked_scores(q, k, sm_scale, tk, causal, q_lo, k_lo):
+    """(block_q, block_k) score tile on the MXU (f32 accumulation), with
+    out-of-range and above-diagonal entries set to _NEG_INF.  The single
+    source of the score/mask convention shared by the forward and both
+    backward kernels."""
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    s = s * sm_scale
+    kpos = k_lo + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    mask = kpos < tk
+    if causal:
+        qpos = q_lo + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        mask = mask & (kpos <= qpos)
+    return jnp.where(mask, s, _NEG_INF), mask
+
+
+def _tile_probs(q_ref, k_ref, lse_ref, sm_scale, tk, causal, q_lo, k_lo):
+    """Recompute the softmax probabilities of one tile from (q, k, lse) —
+    the flash-backward recurrence shared by the dQ and dK/dV kernels."""
+    s, mask = _masked_scores(q_ref[0], k_ref[0], sm_scale, tk, causal,
+                             q_lo, k_lo)
+    p = jnp.exp(s - lse_ref[0])                             # (bq, bk) f32
+    return jnp.where(mask, p, 0.0)
+
 
 def _make_fwd_kernel(sm_scale, tk, block_q, block_k, causal):
     from jax.experimental import pallas as pl
@@ -75,18 +111,8 @@ def _make_fwd_kernel(sm_scale, tk, block_q, block_k, causal):
         k_lo = ki * block_k
 
         def body():
-            q = q_ref[0]
-            k = k_ref[0]
-            # (block_q, block_k) score tile on the MXU, f32 accumulation
-            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                    preferred_element_type=jnp.float32)
-            s = s * sm_scale
-            kpos = k_lo + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            mask = kpos < tk
-            if causal:
-                qpos = q_lo + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-                mask = mask & (kpos <= qpos)
-            s = jnp.where(mask, s, _NEG_INF)
+            s, mask = _masked_scores(q_ref[0], k_ref[0], sm_scale, tk,
+                                     causal, q_lo, k_lo)
             m_prev = m_scr[:, 0:1]
             l_prev = l_scr[:, 0:1]
             m_cur = jnp.max(s, axis=1, keepdims=True)
@@ -157,8 +183,8 @@ def _fwd_call(q, k, v, causal, sm_scale, block_q, block_k):
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, tqp, dp), q.dtype),
-            jax.ShapeDtypeStruct((bh, tqp, 1), jnp.float32),
+            _out_struct((bh, tqp, dp), q.dtype, qp, kp, vp),
+            _out_struct((bh, tqp, 1), jnp.float32, qp, kp, vp),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANE), jnp.float32),   # running max m
@@ -191,23 +217,13 @@ def _make_dq_kernel(sm_scale, tk, block_q, block_k, causal):
         k_lo = ki * block_k
 
         def body():
-            # keep q/k/v/do in their input dtype: bf16 inputs run bf16 MXU
+            # q/k/v/do stay in their input dtype: bf16 inputs run bf16 MXU
             # passes with f32 accumulation (preferred_element_type)
-            q = q_ref[0]
             k = k_ref[0]
             v = v_ref[0]
             do = do_ref[0]
-            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                    preferred_element_type=jnp.float32)
-            s = s * sm_scale
-            kpos = k_lo + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            mask = kpos < tk
-            if causal:
-                qpos = q_lo + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-                mask = mask & (kpos <= qpos)
-            s = jnp.where(mask, s, _NEG_INF)
-            p = jnp.exp(s - lse_ref[0])                     # (bq, bk) f32
-            p = jnp.where(mask, p, 0.0)
+            p = _tile_probs(q_ref, k_ref, lse_ref, sm_scale, tk, causal,
+                            q_lo, k_lo)
             dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                      preferred_element_type=jnp.float32)
             ds = (p * (dp - delta_ref[0])).astype(k.dtype)  # (bq, bk)
@@ -248,20 +264,10 @@ def _make_dkv_kernel(sm_scale, tk, block_q, block_k, causal):
 
         def body():
             q = q_ref[0]
-            k = k_ref[0]
             v = v_ref[0]
             do = do_ref[0]
-            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                    preferred_element_type=jnp.float32)
-            s = s * sm_scale
-            kpos = k_lo + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            mask = kpos < tk
-            if causal:
-                qpos = q_lo + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-                mask = mask & (kpos <= qpos)
-            s = jnp.where(mask, s, _NEG_INF)
-            p = jnp.exp(s - lse_ref[0])                     # (bq, bk) f32
-            p = jnp.where(mask, p, 0.0)
+            p = _tile_probs(q_ref, k_ref, lse_ref, sm_scale, tk, causal,
+                            q_lo, k_lo)
             # padded q rows contribute nothing: their do and delta are zero
             dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
                 p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -322,7 +328,7 @@ def _bwd_call(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k):
         grid=(bh, tqp // block_q, tkp // block_k),
         in_specs=[q_spec, kv_spec_dq, kv_spec_dq, q_spec, row_spec, row_spec],
         out_specs=q_spec,
-        out_shape=jax.ShapeDtypeStruct((bh, tqp, dp), q.dtype),
+        out_shape=_out_struct((bh, tqp, dp), q.dtype, qp, kp, vp, dop),
         scratch_shapes=[pltpu.VMEM((block_q, dp), jnp.float32)],
         interpret=_use_interpret(),
     )(qp, kp, vp, dop, lsep, deltap)
@@ -340,8 +346,8 @@ def _bwd_call(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k):
         in_specs=[q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, row_spec_t,
                   row_spec_t],
         out_specs=[kv_spec_t, kv_spec_t],
-        out_shape=[jax.ShapeDtypeStruct((bh, tkp, dp), k.dtype),
-                   jax.ShapeDtypeStruct((bh, tkp, dp), v.dtype)],
+        out_shape=[_out_struct((bh, tkp, dp), k.dtype, qp, kp, vp, dop),
+                   _out_struct((bh, tkp, dp), v.dtype, qp, kp, vp, dop)],
         scratch_shapes=[pltpu.VMEM((block_k, dp), jnp.float32),
                         pltpu.VMEM((block_k, dp), jnp.float32)],
         interpret=_use_interpret(),

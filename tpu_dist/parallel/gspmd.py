@@ -101,7 +101,12 @@ def make_gspmd_train_step(model, loss_fn, optimizer,
 
     def step(params, opt_state, x, y):
         def loss_of(p):
-            out = model.apply(p, x)
+            # dense attention under GSPMD: XLA's SPMD partitioner cannot cut
+            # a Pallas custom call, so the flash kernel must not be
+            # auto-dispatched inside a sharded jit (see nn.attention)
+            from ..nn.attention import attention_impl
+            with attention_impl("dense"):
+                out = model.apply(p, x)
             return loss_fn(out, y), out
 
         (loss, out), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
