@@ -109,7 +109,9 @@ def test_no_store_rejects_port_negotiation(tmp_path):
 
 
 def test_pass_local_rank_argv(tmp_path):
-    r = _launch(tmp_path, ["--master_port=29715", "--pass_local_rank"])
+    # negotiated port (=0): a fixed one can linger in TIME_WAIT from earlier
+    # multiprocess tests and flake the rendezvous under full-suite load
+    r = _launch(tmp_path, ["--master_port=0", "--pass_local_rank"])
     assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
     res = _results(tmp_path)
     for rank in res:

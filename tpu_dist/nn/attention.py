@@ -143,7 +143,7 @@ class MultiheadSelfAttention(Module):
             fn = (ring_self_attention if self.mode == "ring"
                   else ulysses_self_attention)
             out = fn(q, k, v, axis_name=self.sequence_axis,
-                     causal=self.causal)
+                     causal=self.causal, impl=self.attn_impl)
         else:
             out = scaled_dot_product_attention(q, k, v, causal=self.causal,
                                                impl=self.attn_impl)

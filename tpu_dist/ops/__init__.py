@@ -5,6 +5,7 @@ them from XLA, and this package holds the hand-written Pallas kernels for the
 cases worth owning: ops where fusion XLA can't see saves HBM traffic."""
 
 from .cross_entropy import fused_cross_entropy
-from .flash_attention import flash_attention
+from .flash_attention import flash_attention, flash_attention_with_lse
 
-__all__ = ["fused_cross_entropy", "flash_attention"]
+__all__ = ["fused_cross_entropy", "flash_attention",
+           "flash_attention_with_lse"]

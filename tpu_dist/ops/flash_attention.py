@@ -37,7 +37,7 @@ import math
 import jax
 import jax.numpy as jnp
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_attention_with_lse"]
 
 _LANE = 128
 _D_ALIGN = 64  # head_dim alignment: 64 halves K/V DMA for d=64 vs padding to 128
@@ -49,7 +49,17 @@ def _ceil_to(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
-def _use_interpret() -> bool:
+def _use_interpret():
+    """Compiled Mosaic on TPU; the HLO interpreter everywhere else.
+
+    NOTE every kernel body below is wrapped in ``pl.when`` (the causal
+    tile-skip predicate, or a trivially-true one).  That is not only the
+    causal optimization: the HLO interpreter's discharge of a *bare* kernel
+    body trips shard_map's varying-manual-axes check (ops mixing
+    device-varying block data with invariant constants), while the
+    ``pl.when``-discharged form composes — and the ring-attention flash
+    path and DDP wrapper both trace these kernels inside shard_map.
+    """
     return jax.default_backend() != "tpu"
 
 
@@ -132,12 +142,11 @@ def _make_fwd_kernel(sm_scale, tk, block_q, block_k, causal):
             acc_scr[:] = acc_scr[:] * alpha + pv
             m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
 
-        if causal:
-            # tiles entirely above the diagonal contribute nothing
-            @pl.when(k_lo <= q_lo + block_q - 1)
-            def _():
-                body()
-        else:
+        # tiles entirely above the diagonal contribute nothing; non-causal
+        # uses a trivially-true predicate (see _use_interpret for why the
+        # body must be under pl.when either way)
+        @pl.when(k_lo <= q_lo + block_q - 1 if causal else ki >= 0)
+        def _():
             body()
 
         @pl.when(ki == nk - 1)
@@ -231,11 +240,8 @@ def _make_dq_kernel(sm_scale, tk, block_q, block_k, causal):
                 ds, k, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
 
-        if causal:
-            @pl.when(k_lo <= q_lo + block_q - 1)
-            def _():
-                body()
-        else:
+        @pl.when(k_lo <= q_lo + block_q - 1 if causal else ki >= 0)
+        def _():
             body()
 
         @pl.when(ki == nk - 1)
@@ -279,11 +285,8 @@ def _make_dkv_kernel(sm_scale, tk, block_q, block_k, causal):
                 ds, q, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
 
-        if causal:
-            @pl.when(q_lo + block_q - 1 >= k_lo)
-            def _():
-                body()
-        else:
+        @pl.when(q_lo + block_q - 1 >= k_lo if causal else qi >= 0)
+        def _():
             body()
 
         @pl.when(qi == nq - 1)
@@ -294,7 +297,8 @@ def _make_dkv_kernel(sm_scale, tk, block_q, block_k, causal):
     return kernel
 
 
-def _bwd_call(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k):
+def _bwd_call(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k,
+              dlse=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -305,9 +309,14 @@ def _bwd_call(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k):
     tqp, tkp, dp = _ceil_to(tq, block_q), _ceil_to(tk, block_k), _ceil_to(d, _D_ALIGN)
 
     # delta_i = rowsum(dO_i * O_i) — the softmax-jacobian correction term;
-    # cheap elementwise jnp, fused by XLA around the kernels
+    # cheap elementwise jnp, fused by XLA around the kernels.  When the
+    # caller differentiates through lse too (ring-attention merge), its
+    # cotangent enters the same place with opposite sign:
+    # dL/ds_ij = p_ij * (dp_ij - delta_i + dlse_i), so fold it into delta.
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)                  # (BH, Tq, 1)
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
 
     qp = jnp.pad(q, ((0, 0), (0, tqp - tq), (0, dp - d)))
     kp = jnp.pad(k, ((0, 0), (0, tkp - tk), (0, dp - d)))
@@ -360,35 +369,43 @@ def _bwd_call(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k):
 # ---------------------------------------------------------------------------
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, sm_scale, block_q, block_k):
-    o, _ = _fwd_call(q, k, v, causal, sm_scale, block_q, block_k)
-    return o
+def _flash_lse(q, k, v, causal, sm_scale, block_q, block_k):
+    """Like _flash but also returns the per-row logsumexp — the merge
+    currency of blockwise/ring attention.  Differentiable in BOTH outputs."""
+    return _fwd_call(q, k, v, causal, sm_scale, block_q, block_k)
 
 
-def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+def _flash_lse_fwd(q, k, v, causal, sm_scale, block_q, block_k):
     o, lse = _fwd_call(q, k, v, causal, sm_scale, block_q, block_k)
-    return o, (q, k, v, o, lse)
+    return (o, lse), (q, k, v, o, lse)
 
 
-def _flash_bwd(causal, sm_scale, block_q, block_k, res, g):
+def _flash_lse_bwd(causal, sm_scale, block_q, block_k, res, cts):
     q, k, v, o, lse = res
-    return _bwd_call(q, k, v, o, lse, g, causal, sm_scale, block_q, block_k)
+    do, dlse = cts
+    return _bwd_call(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k,
+                     dlse=dlse)
 
 
-_flash.defvjp(_flash_fwd, _flash_bwd)
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
-def flash_attention(q, k, v, causal: bool = False, sm_scale=None,
-                    block_q: int = 1024, block_k: int = 1024):
-    """Flash attention.  ``q``: (..., Tq, H, D); ``k, v``: (..., Tk, H, D).
+def flash_attention_with_lse(q, k, v, causal: bool = False, sm_scale=None,
+                             block_q: int = 1024, block_k: int = 1024):
+    """Flash attention returning ``(out, lse)``.
 
-    Drop-in for :func:`tpu_dist.nn.attention.scaled_dot_product_attention`
-    (mask=None); differentiable; O(T) memory.  ``block_q``/``block_k`` are
-    VMEM tile sizes (auto-clamped for short sequences).  The 1024 defaults
-    are from an on-chip sweep at (4, 8192, 8, 64) bf16 causal: large tiles
-    amortize grid/DMA overhead and win ~2.5x over 128 tiles for training
-    (fwd+bwd); measured vs jax.experimental.pallas.ops.tpu.flash_attention
-    at the same shape this kernel is ~2x (fwd) / ~4x (fwd+bwd) faster.
+    ``out``: (..., Tq, H, D) like :func:`flash_attention`; ``lse``:
+    (..., Tq, H) float32 per-row logsumexp of the scaled scores.  Partial
+    results ``(out_a, lse_a), (out_b, lse_b)`` over disjoint KV blocks merge
+    exactly (the blockwise-attention identity used by
+    tpu_dist.parallel.ring_attention)::
+
+        m = max(lse_a, lse_b); w = exp(lse_? - m)
+        out = (out_a*w_a + out_b*w_b) / (w_a + w_b); lse = m + log(w_a + w_b)
+
+    Differentiable in both outputs (the lse cotangent folds into the
+    softmax-jacobian correction).  Rows with no visible keys get lse ≈ -1e30
+    and out 0 — the merge weight exp(lse - m) then vanishes exactly.
     """
     if q.ndim < 3:
         raise ValueError(f"expected (..., T, H, D), got {q.shape}")
@@ -407,9 +424,30 @@ def flash_attention(q, k, v, causal: bool = False, sm_scale=None,
 
     def to3(x, t):
         x = x.reshape(-1, t, h, d)
-        return jnp.swapaxes(x, 1, 2).reshape(-1, t, d)       # (B*H, T, D)
+        return jnp.swapaxes(x, 1, 2).reshape(-1, t, d)
 
-    o3 = _flash(to3(q, tq), to3(k, tk), to3(v, tk), causal, float(sm_scale),
-                int(block_q), int(block_k))
-    o = jnp.swapaxes(o3.reshape(-1, h, tq, d), 1, 2)
-    return o.reshape(*lead, tq, h, d)
+    o3, lse3 = _flash_lse(to3(q, tq), to3(k, tk), to3(v, tk), causal,
+                          float(sm_scale), int(block_q), int(block_k))
+    o = jnp.swapaxes(o3.reshape(-1, h, tq, d), 1, 2).reshape(*lead, tq, h, d)
+    lse = jnp.swapaxes(lse3.reshape(-1, h, tq), 1, 2)       # (B, Tq, H)
+    return o, lse.reshape(*lead, tq, h)
+
+
+def flash_attention(q, k, v, causal: bool = False, sm_scale=None,
+                    block_q: int = 1024, block_k: int = 1024):
+    """Flash attention.  ``q``: (..., Tq, H, D); ``k, v``: (..., Tk, H, D).
+
+    Drop-in for :func:`tpu_dist.nn.attention.scaled_dot_product_attention`
+    (mask=None); differentiable; O(T) memory.  ``block_q``/``block_k`` are
+    VMEM tile sizes (auto-clamped for short sequences).  The 1024 defaults
+    are from an on-chip sweep at (4, 8192, 8, 64) bf16 causal: large tiles
+    amortize grid/DMA overhead and win ~2.5x over 128 tiles for training
+    (fwd+bwd); measured vs jax.experimental.pallas.ops.tpu.flash_attention
+    at the same shape this kernel is ~2x (fwd) / ~4x (fwd+bwd) faster.
+
+    Same computation as :func:`flash_attention_with_lse` with the lse
+    discarded (its cotangent is then zero, so the backward is identical).
+    """
+    return flash_attention_with_lse(q, k, v, causal=causal,
+                                    sm_scale=sm_scale, block_q=block_q,
+                                    block_k=block_k)[0]

@@ -61,12 +61,28 @@ def _block_attend(q, k, v, scale, q_offset, k_offset, causal):
     return num, den, mx
 
 
-def ring_self_attention(q, k, v, axis_name: str, causal: bool = False):
+def ring_self_attention(q, k, v, axis_name: str, causal: bool = False,
+                        impl: str = None):
     """Exact attention over the sequence sharded on ``axis_name``.
 
     Call inside ``shard_map``; per-device shapes (B, T/n, H, D).  Returns the
     local (B, T/n, H, D) output shard.
+
+    ``impl``: how each local (Q-shard × KV-block) attention is computed —
+    ``"flash"`` runs the Pallas flash kernel per block and merges partial
+    results via their logsumexp (O(T/n) memory per device, MXU-tiled);
+    ``"dense"`` materializes the (T/n, T/n) block scores (the portable
+    path).  Default auto: flash on TPU, dense elsewhere.  Under ``"flash"``
+    with ``causal``, blocks entirely above the diagonal skip the kernel
+    call outright (``lax.switch``) instead of computing a fully-masked
+    block.
     """
+    if impl in (None, "auto"):
+        impl = "flash" if jax.default_backend() == "tpu" else "dense"
+    if impl == "flash":
+        return _ring_flash(q, k, v, axis_name, causal)
+    if impl != "dense":
+        raise ValueError(f"Unknown ring attention impl {impl!r}")
     n = lax.axis_size(axis_name)
     me = lax.axis_index(axis_name)
     scale = 1.0 / math.sqrt(q.shape[-1])
@@ -110,11 +126,83 @@ def ring_self_attention(q, k, v, axis_name: str, causal: bool = False):
     return num / jnp.maximum(den, 1e-37)
 
 
-def ulysses_self_attention(q, k, v, axis_name: str, causal: bool = False):
+def _ring_flash(q, k, v, axis_name: str, causal: bool):
+    """Ring attention with flash-kernel local blocks.
+
+    Each hop computes its (Q-shard × KV-block) attention with
+    tpu_dist.ops.flash_attention_with_lse and folds the partial result into
+    the running one with the blockwise-merge identity (see that function's
+    docstring) — the same online-softmax recurrence as the dense path, but
+    carried as (out, lse) so the local block math lives in VMEM tiles.
+
+    Causal block classification: hop 0 is statically the diagonal block
+    (causal flash); for later hops the traced source index picks via
+    ``lax.cond`` between plain flash (block fully below the diagonal) and a
+    zero-contribution constant (lse = -1e30, block fully above) — the
+    latter skips the kernel entirely, so a causal ring does ~half the
+    kernel work at flash's memory footprint.
+    """
+    from ..ops.flash_attention import flash_attention_with_lse
+
+    n = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    perm = [(i, (i - 1) % n) for i in range(n)]
+
+    def block(i, kk, vv):
+        """(out, lse) of q attending to the block that started at rank
+        (me + i) mod n, as float32 (the loop-carry/merge dtype — bf16 inputs
+        stay bf16 inside the kernel, the carry must not down-cast partials).
+        i == 0 is always the diagonal block; for i in [1, n-1] the source
+        can never be me again, so causal blocks are a two-way choice."""
+
+        def flash(is_causal):
+            o, l = flash_attention_with_lse(q, kk, vv, causal=is_causal)
+            return o.astype(jnp.float32), l
+
+        if not causal:
+            return flash(False)
+        if isinstance(i, int) and i == 0:
+            return flash(True)
+        src = jnp.mod(me + i, n)
+
+        def full(_):
+            return flash(False)
+
+        def skip(_):
+            # zero contribution; derive from q so the VMA set matches
+            zero = (q * 0.0).astype(jnp.float32)
+            return zero, zero.sum(-1) + _NEG_INF        # (B,T,H) lse
+
+        return lax.cond(src < me, full, skip, None)
+
+    def merge(o_a, l_a, o_b, l_b):
+        m = jnp.maximum(l_a, l_b)
+        w_a = jnp.exp(l_a - m)
+        w_b = jnp.exp(l_b - m)
+        den = jnp.maximum(w_a + w_b, 1e-37)
+        o = (o_a * w_a[..., None] + o_b * w_b[..., None]) / den[..., None]
+        return o, m + jnp.log(den)
+
+    o, l = block(0, k, v)
+
+    def hop(i, carry):
+        o, l, kk, vv = carry
+        kk, vv = lax.ppermute((kk, vv), axis_name, perm=perm)
+        o_b, l_b = block(i, kk, vv)
+        o, l = merge(o, l, o_b, l_b)
+        return o, l, kk, vv
+
+    o, _, _, _ = lax.fori_loop(1, n, hop, (o, l, k, v))
+    return o.astype(q.dtype)
+
+
+def ulysses_self_attention(q, k, v, axis_name: str, causal: bool = False,
+                           impl: str = None):
     """Sequence-parallel attention via head redistribution (Ulysses).
 
     Inside ``shard_map``: (B, T/n, H, D) → all-to-all → (B, T, H/n, D) →
-    dense attention → all-to-all back.  Requires H % axis_size == 0.
+    local attention (``impl`` as in scaled_dot_product_attention: auto =
+    flash kernel on TPU) → all-to-all back.  Requires H % axis_size == 0.
     """
     n = lax.axis_size(axis_name)
     if q.shape[2] % n:
@@ -133,5 +221,5 @@ def ulysses_self_attention(q, k, v, axis_name: str, causal: bool = False):
                               tiled=True)
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    out = scaled_dot_product_attention(qh, kh, vh, causal=causal)
+    out = scaled_dot_product_attention(qh, kh, vh, causal=causal, impl=impl)
     return heads_to_seq(out)
