@@ -31,6 +31,28 @@ class TestForward:
         out = model.apply(params, _tokens())
         assert out.shape == (2, 64, 50)
 
+    def test_remat_matches_no_remat(self):
+        # rematerialization changes memory, not math: forward and grads
+        # must be identical (same ops, recomputed in backward)
+        toks = _tokens(t=32)
+        targets = jnp.roll(toks, -1, axis=1)
+        ce = nn.CrossEntropyLoss()
+        outs = {}
+        for remat in (False, True):
+            model = TransformerLM(vocab_size=50, dim=32, depth=2,
+                                  num_heads=4, max_seq_len=64, remat=remat)
+            params = model.init(jax.random.key(0))
+
+            def loss(p):
+                return ce(model.apply(p, toks).reshape(-1, 50),
+                          targets.reshape(-1))
+
+            l, g = jax.jit(jax.value_and_grad(loss))(params)
+            outs[remat] = (float(l), g)
+        assert outs[False][0] == pytest.approx(outs[True][0], rel=1e-6)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            a, b, atol=1e-6, rtol=1e-6), outs[False][1], outs[True][1])
+
     @pytest.mark.parametrize("mode", ["ring", "ulysses"])
     def test_sequence_parallel_matches_dense(self, mesh, mode):
         """Same params, same tokens: seq-sharded model == dense model."""

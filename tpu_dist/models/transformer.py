@@ -14,6 +14,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 
 from .. import nn
@@ -53,7 +54,7 @@ class TransformerLM(nn.Module):
     def __init__(self, vocab_size: int, dim: int = 128, depth: int = 2,
                  num_heads: int = 4, max_seq_len: int = 1024,
                  causal: bool = True, sequence_axis: Optional[str] = None,
-                 mode: str = "ring"):
+                 mode: str = "ring", remat: bool = False):
         super().__init__()
         self.vocab_size = vocab_size
         self.max_seq_len = max_seq_len
@@ -65,6 +66,12 @@ class TransformerLM(nn.Module):
                 sequence_axis=sequence_axis, mode=mode))
         self.depth = depth
         self.sequence_axis = sequence_axis
+        # remat=True wraps each block in jax.checkpoint: activations inside
+        # a block are recomputed during backward instead of living in HBM
+        # for the whole step — the standard long-context memory/FLOPs trade
+        # (per-layer residual-boundary policy, like torch's
+        # checkpoint_sequential over blocks)
+        self.remat = remat
         self.ln_f = nn.LayerNorm(dim)
         self.head = nn.Linear(dim, vocab_size)
 
@@ -78,5 +85,12 @@ class TransformerLM(nn.Module):
                 pos_offset = 0
         x = self.tok(idx) + self.pos(pos_offset + jnp.arange(t))
         for i in range(self.depth):
-            x = getattr(self, f"block{i}")(x)
+            block = getattr(self, f"block{i}")
+            if self.remat:
+                # params reach the block through the apply() context as
+                # closed-over tracers; jax.checkpoint differentiates through
+                # closures, so no explicit param plumbing is needed
+                x = jax.checkpoint(lambda y, _b=block: _b(y))(x)
+            else:
+                x = block(x)
         return self.head(self.ln_f(x))

@@ -49,6 +49,18 @@ def _ceil_to(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+def _clamp_blocks(dtype, tq, tk, block_q, block_k):
+    """Tile sizes that fit VMEM: the 1024 defaults are tuned for bf16; with
+    f32 inputs the tile intermediates double and the dK/dV kernel's
+    (block_q, block_k) f32 score/prob/ds tiles blow the ~16 MB VMEM budget
+    at 1024² (observed: 16.17M > 16M on v5e) — halve for 4-byte dtypes."""
+    if jnp.dtype(dtype).itemsize >= 4:
+        block_q = min(block_q, 512)
+        block_k = min(block_k, 512)
+    return (min(block_q, _ceil_to(tq, _LANE)),
+            min(block_k, _ceil_to(tk, _LANE)))
+
+
 def _use_interpret():
     """Compiled Mosaic on TPU; the HLO interpreter everywhere else.
 
@@ -167,8 +179,7 @@ def _fwd_call(q, k, v, causal, sm_scale, block_q, block_k):
 
     bh, tq, d = q.shape
     tk = k.shape[1]
-    block_q = min(block_q, _ceil_to(tq, _LANE))
-    block_k = min(block_k, _ceil_to(tk, _LANE))
+    block_q, block_k = _clamp_blocks(q.dtype, tq, tk, block_q, block_k)
     tqp, tkp, dp = _ceil_to(tq, block_q), _ceil_to(tk, block_k), _ceil_to(d, _D_ALIGN)
     qp = jnp.pad(q, ((0, 0), (0, tqp - tq), (0, dp - d)))
     kp = jnp.pad(k, ((0, 0), (0, tkp - tk), (0, dp - d)))
@@ -304,8 +315,7 @@ def _bwd_call(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k,
 
     bh, tq, d = q.shape
     tk = k.shape[1]
-    block_q = min(block_q, _ceil_to(tq, _LANE))
-    block_k = min(block_k, _ceil_to(tk, _LANE))
+    block_q, block_k = _clamp_blocks(q.dtype, tq, tk, block_q, block_k)
     tqp, tkp, dp = _ceil_to(tq, block_q), _ceil_to(tk, block_k), _ceil_to(d, _D_ALIGN)
 
     # delta_i = rowsum(dO_i * O_i) — the softmax-jacobian correction term;

@@ -12,8 +12,10 @@ the same two-phase neighbor-exchange structure as ring all-reduce
 blocks), accumulated with the online-softmax (flash) recurrence so the full
 T×T score matrix never materializes.  Communication per device is O(T/n)
 per hop × n hops = O(T) total, overlapped with the per-block attention
-compute; memory is O((T/n)²) per block.  On TPU the hops ride neighboring
-ICI links.
+compute.  Per-device attention memory is O(T/n) on the default TPU path
+(each block runs the Pallas flash kernel, see ``impl``); the portable
+dense-block path materializes O((T/n)²) scores per block.  On TPU the hops
+ride neighboring ICI links.
 
 **Ulysses** (`ulysses_self_attention`): ``lax.all_to_all`` re-shards from
 sequence-sharded to head-sharded, runs dense per-head attention locally,
@@ -27,6 +29,7 @@ from __future__ import annotations
 
 import math
 from functools import partial
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -62,7 +65,7 @@ def _block_attend(q, k, v, scale, q_offset, k_offset, causal):
 
 
 def ring_self_attention(q, k, v, axis_name: str, causal: bool = False,
-                        impl: str = None):
+                        impl: Optional[str] = None):
     """Exact attention over the sequence sharded on ``axis_name``.
 
     Call inside ``shard_map``; per-device shapes (B, T/n, H, D).  Returns the
@@ -74,8 +77,7 @@ def ring_self_attention(q, k, v, axis_name: str, causal: bool = False,
     ``"dense"`` materializes the (T/n, T/n) block scores (the portable
     path).  Default auto: flash on TPU, dense elsewhere.  Under ``"flash"``
     with ``causal``, blocks entirely above the diagonal skip the kernel
-    call outright (``lax.switch``) instead of computing a fully-masked
-    block.
+    call outright (``lax.cond``) instead of computing a fully-masked block.
     """
     if impl in (None, "auto"):
         impl = "flash" if jax.default_backend() == "tpu" else "dense"
@@ -197,7 +199,7 @@ def _ring_flash(q, k, v, axis_name: str, causal: bool):
 
 
 def ulysses_self_attention(q, k, v, axis_name: str, causal: bool = False,
-                           impl: str = None):
+                           impl: Optional[str] = None):
     """Sequence-parallel attention via head redistribution (Ulysses).
 
     Inside ``shard_map``: (B, T/n, H, D) → all-to-all → (B, T, H/n, D) →
