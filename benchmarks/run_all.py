@@ -19,13 +19,15 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def main() -> None:
     sys.path.insert(0, _REPO)
-    from benchmarks import attention, input_pipeline, resnet_cifar, scaling
+    from benchmarks import (attention, input_pipeline, resnet_cifar,
+                            scaling, transformer_lm)
 
     results = []
     for name, fn in (("resnet_cifar", resnet_cifar.run),
                      ("scaling", scaling.run),
                      ("input_pipeline", input_pipeline.run),
-                     ("attention", attention.run)):
+                     ("attention", attention.run),
+                     ("transformer_lm", transformer_lm.run)):
         try:
             r = fn()
         except Exception as e:  # record the failure, keep the rest running

@@ -122,3 +122,22 @@ class TestMixedPrecision:
         # bf16 forward: loss agrees to ~1e-2
         np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]),
                                    rtol=5e-2)
+
+
+class TestFusedLossUnderDDP:
+    def test_fused_ce_matches_unfused(self, pg):
+        """CrossEntropyLoss(fused=True) — the Pallas CE kernel — inside the
+        DDP shard_map step: regression for vma-annotated kernel outputs
+        (the kernel is traced inside shard_map here)."""
+        x, y = _batch(64)
+        plain = _mk(pg)
+        fused = DDP(ConvNet(), optimizer=optim.SGD(lr=0.05, momentum=0.9),
+                    loss_fn=nn.CrossEntropyLoss(fused=True), group=pg,
+                    donate=False)
+        s_p, m_p = plain.train_step(plain.init(seed=0), x, y)
+        s_f, m_f = fused.train_step(fused.init(seed=0), x, y)
+        np.testing.assert_allclose(float(m_p["loss"]), float(m_f["loss"]),
+                                   rtol=1e-5)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6),
+            s_p.params, s_f.params)

@@ -1,0 +1,33 @@
+"""Shared plumbing for the Pallas kernels in this package."""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["use_interpret", "out_struct"]
+
+
+def use_interpret() -> bool:
+    """Compiled Mosaic on TPU; the HLO interpreter everywhere else.
+
+    NOTE every kernel body in this package is wrapped in ``pl.when`` (a
+    causal tile-skip predicate, or a trivially-true one).  That is not only
+    an optimization: the HLO interpreter's discharge of a *bare* kernel
+    body trips shard_map's varying-manual-axes check (ops mixing
+    device-varying block data with invariant constants), while the
+    ``pl.when``-discharged form composes — and the DDP wrapper and
+    ring-attention flash path trace these kernels inside shard_map.
+    """
+    return jax.default_backend() != "tpu"
+
+
+def out_struct(shape, dtype, *operands):
+    """ShapeDtypeStruct carrying the union of the operands' varying-mesh-
+    axes sets — required for pallas_call outputs traced inside shard_map
+    (e.g. under the DDP wrapper), harmless outside it.  The vma probe is
+    version-sensitive JAX-internals territory; this is the single copy."""
+    try:
+        vma = frozenset().union(*(jax.typeof(x).vma for x in operands))
+    except (AttributeError, TypeError):
+        return jax.ShapeDtypeStruct(shape, dtype)
+    return jax.ShapeDtypeStruct(shape, dtype, vma=vma)

@@ -25,6 +25,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ._pallas import out_struct as _out_struct, use_interpret as _use_interpret
+
 __all__ = ["fused_cross_entropy"]
 
 _TILE_B = 8  # f32 sublane size; one row block per grid step
@@ -35,35 +37,44 @@ def _ceil_to(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
-def _use_interpret() -> bool:
-    return jax.default_backend() != "tpu"
-
-
 def _fwd_kernel(logits_ref, labels_ref, nll_ref, lse_ref, *, vocab: int):
-    logits = logits_ref[:].astype(jnp.float32)          # (TILE_B, Vpad)
-    cols = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
-    valid = cols < vocab
-    logits = jnp.where(valid, logits, -jnp.inf)
-    mx = jnp.max(logits, axis=1, keepdims=True)          # (TILE_B, 1)
-    shifted = logits - mx
-    sumexp = jnp.sum(jnp.where(valid, jnp.exp(shifted), 0.0), axis=1,
-                     keepdims=True)
-    lse = mx + jnp.log(sumexp)                           # (TILE_B, 1)
-    onehot = cols == labels_ref[:]                       # (TILE_B, Vpad)
-    picked = jnp.sum(jnp.where(onehot, logits, 0.0), axis=1, keepdims=True)
-    nll_ref[:] = lse - picked
-    lse_ref[:] = lse
+    # body predicated on a trivially-true condition: the HLO interpreter's
+    # discharge of a bare body trips shard_map's varying-axes check (see
+    # flash_attention._use_interpret) and this kernel runs under the DDP
+    # wrapper's shard_map when CrossEntropyLoss(fused=True) is used
+    from jax.experimental import pallas as pl
+
+    @pl.when(pl.program_id(0) >= 0)
+    def _():
+        logits = logits_ref[:].astype(jnp.float32)       # (TILE_B, Vpad)
+        cols = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+        valid = cols < vocab
+        logits = jnp.where(valid, logits, -jnp.inf)
+        mx = jnp.max(logits, axis=1, keepdims=True)      # (TILE_B, 1)
+        shifted = logits - mx
+        sumexp = jnp.sum(jnp.where(valid, jnp.exp(shifted), 0.0), axis=1,
+                         keepdims=True)
+        lse = mx + jnp.log(sumexp)                       # (TILE_B, 1)
+        onehot = cols == labels_ref[:]                   # (TILE_B, Vpad)
+        picked = jnp.sum(jnp.where(onehot, logits, 0.0), axis=1,
+                         keepdims=True)
+        nll_ref[:] = lse - picked
+        lse_ref[:] = lse
 
 
 def _bwd_kernel(logits_ref, labels_ref, lse_ref, g_ref, dlogits_ref, *,
                 vocab: int):
-    logits = logits_ref[:].astype(jnp.float32)
-    cols = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
-    valid = cols < vocab
-    p = jnp.where(valid, jnp.exp(logits - lse_ref[:]), 0.0)
-    onehot = (cols == labels_ref[:]) & valid
-    dlogits_ref[:] = ((p - onehot.astype(jnp.float32)) * g_ref[:]
-                      ).astype(dlogits_ref.dtype)
+    from jax.experimental import pallas as pl
+
+    @pl.when(pl.program_id(0) >= 0)
+    def _():
+        logits = logits_ref[:].astype(jnp.float32)
+        cols = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+        valid = cols < vocab
+        p = jnp.where(valid, jnp.exp(logits - lse_ref[:]), 0.0)
+        onehot = (cols == labels_ref[:]) & valid
+        dlogits_ref[:] = ((p - onehot.astype(jnp.float32)) * g_ref[:]
+                          ).astype(dlogits_ref.dtype)
 
 
 def _pad(logits, labels):
@@ -99,8 +110,8 @@ def _call_fwd(logits, labels):
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bp, 1), jnp.float32),
-            jax.ShapeDtypeStruct((bp, 1), jnp.float32),
+            _out_struct((bp, 1), jnp.float32, logits_p, labels2d),
+            _out_struct((bp, 1), jnp.float32, logits_p, labels2d),
         ],
         interpret=_use_interpret(),
     )(logits_p, labels2d)
@@ -132,7 +143,8 @@ def _call_bwd(logits, labels, lse, g_rows):
         ],
         out_specs=pl.BlockSpec((_TILE_B, vp), lambda i: (i, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((bp, vp), logits.dtype),
+        out_shape=_out_struct((bp, vp), logits.dtype, logits_p, labels2d,
+                              lse2d, g2d),
         interpret=_use_interpret(),
     )(logits_p, labels2d, lse2d, g2d)
     return dlogits[:b, :v]

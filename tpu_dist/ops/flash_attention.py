@@ -37,6 +37,8 @@ import math
 import jax
 import jax.numpy as jnp
 
+from ._pallas import out_struct as _out_struct, use_interpret as _use_interpret
+
 __all__ = ["flash_attention", "flash_attention_with_lse"]
 
 _LANE = 128
@@ -59,31 +61,6 @@ def _clamp_blocks(dtype, tq, tk, block_q, block_k):
         block_k = min(block_k, 512)
     return (min(block_q, _ceil_to(tq, _LANE)),
             min(block_k, _ceil_to(tk, _LANE)))
-
-
-def _use_interpret():
-    """Compiled Mosaic on TPU; the HLO interpreter everywhere else.
-
-    NOTE every kernel body below is wrapped in ``pl.when`` (the causal
-    tile-skip predicate, or a trivially-true one).  That is not only the
-    causal optimization: the HLO interpreter's discharge of a *bare* kernel
-    body trips shard_map's varying-manual-axes check (ops mixing
-    device-varying block data with invariant constants), while the
-    ``pl.when``-discharged form composes — and the ring-attention flash
-    path and DDP wrapper both trace these kernels inside shard_map.
-    """
-    return jax.default_backend() != "tpu"
-
-
-def _out_struct(shape, dtype, *operands):
-    """ShapeDtypeStruct whose varying-mesh-axes set is the union of the
-    operands' — required for pallas_call outputs traced inside shard_map
-    (e.g. under the DDP wrapper), harmless outside it."""
-    try:
-        vma = frozenset().union(*(jax.typeof(x).vma for x in operands))
-    except (AttributeError, TypeError):
-        return jax.ShapeDtypeStruct(shape, dtype)
-    return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
 
 
 # ---------------------------------------------------------------------------
