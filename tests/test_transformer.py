@@ -110,3 +110,86 @@ class TestTraining:
         params = model.init(jax.random.key(0))
         out = model.apply(params, _tokens(b=1, t=16, vocab=8))
         assert out.shape == (1, 16, 8)
+
+
+class TestGenerate:
+    def _model(self, **kw):
+        model = TransformerLM(vocab_size=50, dim=32, depth=2, num_heads=4,
+                              max_seq_len=64, **kw)
+        return model, model.init(jax.random.key(0))
+
+    def test_cached_decode_matches_full_forward(self):
+        """Teacher-forced decode through the KV cache must reproduce the
+        dense forward's logits position by position (the decode oracle)."""
+        model, params = self._model()
+        toks = _tokens(b=2, t=16)
+        full = model.apply(params, toks)                     # (B, 16, V)
+
+        cache = model.init_cache(batch=2, max_len=16)
+        pre, cache = model.apply(params, toks[:, :5], state=cache)
+        np.testing.assert_allclose(np.asarray(pre), np.asarray(full[:, :5]),
+                                   atol=1e-5, rtol=1e-5)
+        for i in range(5, 16):
+            step, cache = model.apply(params, toks[:, i:i + 1],
+                                      pos_offset=i, state=cache)
+            np.testing.assert_allclose(
+                np.asarray(step[:, 0]), np.asarray(full[:, i]),
+                atol=1e-5, rtol=1e-5, err_msg=f"position {i}")
+
+    def test_generate_greedy_is_deterministic(self):
+        model, params = self._model()
+        prompt = _tokens(b=2, t=8)
+        out1 = model.generate(params, prompt, max_new_tokens=10)
+        out2 = jax.jit(lambda p, t: model.generate(p, t, 10))(params, prompt)
+        assert out1.shape == (2, 18)
+        np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+        np.testing.assert_array_equal(np.asarray(out1[:, :8]),
+                                      np.asarray(prompt))
+
+    def test_generate_matches_uncached_greedy(self):
+        """Greedy generate == the naive re-run-the-whole-prefix loop."""
+        model, params = self._model()
+        prompt = _tokens(b=1, t=6)
+        out = model.generate(params, prompt, max_new_tokens=6)
+        seq = prompt
+        for _ in range(6):
+            logits = model.apply(params, seq)
+            seq = jnp.concatenate([seq, logits[:, -1].argmax(-1)[:, None]], 1)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
+
+    def test_generate_sampling_and_errors(self):
+        model, params = self._model()
+        prompt = _tokens(b=2, t=4)
+        out = model.generate(params, prompt, 5, temperature=1.0,
+                             rng=jax.random.key(7))
+        assert out.shape == (2, 9)
+        with pytest.raises(ValueError, match="rng"):
+            model.generate(params, prompt, 5, temperature=1.0)
+        with pytest.raises(ValueError, match="max_seq_len"):
+            model.generate(params, prompt, 100)
+        sp_model = TransformerLM(vocab_size=50, dim=32, depth=1, num_heads=4,
+                                 max_seq_len=64, sequence_axis="seq")
+        with pytest.raises(ValueError, match="sequence_axis"):
+            sp_model.init_cache(batch=1)
+        bidir = TransformerLM(vocab_size=50, dim=32, depth=1, num_heads=4,
+                              max_seq_len=64, causal=False)
+        with pytest.raises(ValueError, match="causal"):
+            bidir.init_cache(batch=1)
+
+    def test_generate_zero_tokens_returns_prompt(self):
+        model, params = self._model()
+        prompt = _tokens(b=2, t=4)
+        out = model.generate(params, prompt, 0)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(prompt))
+        with pytest.raises(ValueError, match=">= 0"):
+            model.generate(params, prompt, -1)
+
+    def test_generate_with_remat_model(self):
+        # remat is silently disabled during decode (checkpoint would leak
+        # the cache-state tracers); generation must match the plain model
+        plain, params = self._model()
+        remat, _ = self._model(remat=True)
+        prompt = _tokens(b=1, t=6)
+        np.testing.assert_array_equal(
+            np.asarray(plain.generate(params, prompt, 6)),
+            np.asarray(remat.generate(params, prompt, 6)))

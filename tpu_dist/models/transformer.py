@@ -65,6 +65,7 @@ class TransformerLM(nn.Module):
                 dim, num_heads, causal=causal,
                 sequence_axis=sequence_axis, mode=mode))
         self.depth = depth
+        self.causal = causal
         self.sequence_axis = sequence_axis
         # remat=True wraps each block in jax.checkpoint: activations inside
         # a block are recomputed during backward instead of living in HBM
@@ -84,9 +85,14 @@ class TransformerLM(nn.Module):
             else:
                 pos_offset = 0
         x = self.tok(idx) + self.pos(pos_offset + jnp.arange(t))
+        # remat is a training-memory trade; during cached decode it must be
+        # off — the attention layers' put_state writes would leak tracers
+        # out of the jax.checkpoint sub-trace (and inference keeps no
+        # activations anyway)
+        use_remat = self.remat and not self._decoding()
         for i in range(self.depth):
             block = getattr(self, f"block{i}")
-            if self.remat:
+            if use_remat:
                 # params reach the block through the apply() context as
                 # closed-over tracers; jax.checkpoint differentiates through
                 # closures, so no explicit param plumbing is needed
@@ -94,3 +100,84 @@ class TransformerLM(nn.Module):
             else:
                 x = block(x)
         return self.head(self.ln_f(x))
+
+    def _decoding(self) -> bool:
+        """True when the current apply() carries a KV cache for this model's
+        attention layers (i.e. we are inside prefill/decode)."""
+        from ..nn.module import current_context
+        ctx = current_context()
+        if ctx is None or not ctx.state:
+            return False
+        return any(getattr(self, f"block{i}").attn._path in ctx.state
+                   for i in range(self.depth))
+
+    # -- autoregressive inference ------------------------------------------
+
+    def init_cache(self, batch: int, max_len: Optional[int] = None,
+                   dtype=jnp.float32):
+        """KV-cache state pytree for :meth:`generate` — one
+        ``{"k", "v", "index"}`` entry per attention layer, keyed by module
+        path, threaded through ``apply(state=...)`` like any mutable state."""
+        if self.sequence_axis is not None:
+            raise ValueError("KV-cache decode runs on gathered sequences; "
+                             "build the model without sequence_axis for "
+                             "generation")
+        if not self.causal:
+            raise ValueError("KV-cache decode requires causal attention: a "
+                             "bidirectional model's logits depend on future "
+                             "tokens and cannot be decoded incrementally")
+        max_len = self.max_seq_len if max_len is None else max_len
+        self._assign_paths()
+        return {attn._path: attn.init_cache(batch, max_len, dtype)
+                for attn in (getattr(self, f"block{i}").attn
+                             for i in range(self.depth))}
+
+    def generate(self, params, prompt, max_new_tokens: int,
+                 temperature: float = 0.0, rng=None, cache_dtype=None):
+        """Autoregressive decoding with a KV cache.
+
+        ``prompt``: int tokens (B, Tp).  Returns (B, Tp + max_new_tokens) —
+        the prompt with the continuation appended.  ``temperature`` 0 is
+        greedy argmax; > 0 samples categorically (``rng`` required).  The
+        prompt is prefilled in ONE forward pass (cache index advances by
+        Tp), then each new token is one t=1 forward through the cache — the
+        whole loop is a ``lax.scan``, so generate() jits to a single XLA
+        program with no per-token dispatch.
+        """
+        b, tp = prompt.shape
+        if max_new_tokens <= 0:
+            if max_new_tokens == 0:
+                return prompt
+            raise ValueError(f"max_new_tokens must be >= 0, got "
+                             f"{max_new_tokens}")
+        total = tp + max_new_tokens
+        if total > self.max_seq_len:
+            raise ValueError(f"prompt ({tp}) + max_new_tokens "
+                             f"({max_new_tokens}) exceeds max_seq_len "
+                             f"({self.max_seq_len})")
+        if temperature > 0 and rng is None:
+            raise ValueError("temperature > 0 sampling requires rng=")
+
+        def sample(logits, key):
+            if temperature <= 0:
+                return logits.argmax(-1)
+            return jax.random.categorical(key, logits / temperature, axis=-1)
+
+        cache = self.init_cache(b, total, cache_dtype or jnp.float32)
+        logits, cache = self.apply(params, prompt, state=cache)
+        key0 = rng if rng is not None else jax.random.key(0)
+        first = sample(logits[:, -1], jax.random.fold_in(key0, 0))
+
+        def step(carry, i):
+            tok, cache = carry
+            logits, cache = self.apply(params, tok[:, None],
+                                       pos_offset=tp + i, state=cache)
+            nxt = sample(logits[:, -1], jax.random.fold_in(key0, i + 1))
+            return (nxt, cache), tok
+
+        (last, _), toks = jax.lax.scan(
+            step, (first, cache), jnp.arange(max_new_tokens - 1))
+        # toks holds tokens emitted *before* each step; append the final one
+        out = jnp.concatenate(
+            [prompt, jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1)
+        return out

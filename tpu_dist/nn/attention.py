@@ -132,12 +132,18 @@ class MultiheadSelfAttention(Module):
 
     def forward(self, x):
         from .module import _ctx
-        p = _ctx().get_params(self._path)
+        ctx = _ctx()
+        p = ctx.get_params(self._path)
         b, t, _ = x.shape
         qkv = F.linear(x, p["qkv_weight"], p.get("qkv_bias"))
         qkv = qkv.reshape(b, t, 3, self.num_heads, self.head_dim)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        if self.sequence_axis is not None:
+        if ctx.state is not None and self._path in ctx.state:
+            # autoregressive decode: a KV cache was allocated for this layer
+            # (TransformerLM.init_cache) — append this call's K/V at the
+            # write index and attend over the cached prefix
+            out = self._decode(ctx, q, k, v)
+        elif self.sequence_axis is not None:
             from ..parallel.ring_attention import (ring_self_attention,
                                                    ulysses_self_attention)
             fn = (ring_self_attention if self.mode == "ring"
@@ -149,6 +155,38 @@ class MultiheadSelfAttention(Module):
                                                impl=self.attn_impl)
         out = out.reshape(b, t, self.embed_dim)
         return F.linear(out, p["out_weight"], p.get("out_bias"))
+
+    def _decode(self, ctx, q, k, v):
+        """Cached attention step.  q/k/v: (B, t, H, D) with t the number of
+        new positions (t>1 = prefill, t=1 = one decode step).  The cache is
+        state ``{"k": (B, Tmax, H, D), "v": ..., "index": ()}``; new keys
+        land at [index, index+t) and queries see cache positions <= their
+        own global position (cache slots past the index are masked, so the
+        zeros there never contribute)."""
+        st = ctx.get_state(self._path)
+        index = st["index"]
+        k_cache = jax.lax.dynamic_update_slice(
+            st["k"], k.astype(st["k"].dtype), (0, index, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            st["v"], v.astype(st["v"].dtype), (0, index, 0, 0))
+        t = q.shape[1]
+        ctx.put_state(self._path, {"k": k_cache, "v": v_cache,
+                                   "index": index + t})
+        tmax = k_cache.shape[1]
+        qpos = index + jnp.arange(t)[:, None]           # (t, 1) global
+        kpos = jnp.arange(tmax)[None, :]                # (1, Tmax)
+        mask = kpos <= qpos                             # causal + unwritten
+        return scaled_dot_product_attention(
+            q, k_cache.astype(q.dtype), v_cache.astype(q.dtype),
+            mask=mask, impl="dense")
+
+    def init_cache(self, batch: int, max_len: int, dtype=jnp.float32):
+        """Per-layer KV cache entry (used via TransformerLM.init_cache)."""
+        return {"k": jnp.zeros((batch, max_len, self.num_heads,
+                                self.head_dim), dtype),
+                "v": jnp.zeros((batch, max_len, self.num_heads,
+                                self.head_dim), dtype),
+                "index": jnp.zeros((), jnp.int32)}
 
     def __repr__(self):
         sp = (f", sequence_axis={self.sequence_axis!r}, mode={self.mode!r}"

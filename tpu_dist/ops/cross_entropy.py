@@ -40,8 +40,8 @@ def _ceil_to(x: int, m: int) -> int:
 def _fwd_kernel(logits_ref, labels_ref, nll_ref, lse_ref, *, vocab: int):
     # body predicated on a trivially-true condition: the HLO interpreter's
     # discharge of a bare body trips shard_map's varying-axes check (see
-    # flash_attention._use_interpret) and this kernel runs under the DDP
-    # wrapper's shard_map when CrossEntropyLoss(fused=True) is used
+    # _pallas.use_interpret) and this kernel runs under the DDP wrapper's
+    # shard_map when CrossEntropyLoss(fused=True) is used
     from jax.experimental import pallas as pl
 
     @pl.when(pl.program_id(0) >= 0)
