@@ -38,3 +38,67 @@ def test_sgd_matches_torch(rng, cfg):
 def test_nesterov_requires_momentum():
     with pytest.raises(ValueError):
         SGD(lr=0.1, nesterov=True)
+
+
+@pytest.mark.parametrize("cls,tcls,cfg", [
+    ("AdamW", torch.optim.AdamW, dict(lr=1e-3)),
+    ("AdamW", torch.optim.AdamW, dict(lr=3e-4, betas=(0.85, 0.98),
+                                      weight_decay=0.1)),
+    ("Adam", torch.optim.Adam, dict(lr=1e-3)),
+    ("Adam", torch.optim.Adam, dict(lr=1e-3, weight_decay=1e-2)),
+])
+def test_adam_family_matches_torch(rng, cls, tcls, cfg):
+    from tpu_dist import optim
+
+    w0 = rng.standard_normal((5, 4)).astype(np.float32)
+    tparam = torch.nn.Parameter(torch.tensor(w0.copy()))
+    topt = tcls([tparam], **cfg)
+
+    opt = getattr(optim, cls)(**cfg)
+    params = {"w": jnp.asarray(w0)}
+    opt_state = opt.init(params)
+
+    for step in range(6):
+        g = rng.standard_normal((5, 4)).astype(np.float32)
+        tparam.grad = torch.tensor(g.copy())
+        topt.step()
+        params, opt_state = opt.update({"w": jnp.asarray(g)}, opt_state,
+                                       params)
+        np.testing.assert_allclose(np.asarray(params["w"]),
+                                   tparam.detach().numpy(), atol=2e-6,
+                                   err_msg=f"step {step} {cls} {cfg}")
+
+
+def test_clip_grad_norm_matches_torch(rng):
+    from tpu_dist.optim import clip_grad_norm, global_norm
+
+    gs = {"a": rng.standard_normal((6, 2)).astype(np.float32),
+          "b": rng.standard_normal(11).astype(np.float32)}
+    tparams = [torch.nn.Parameter(torch.zeros(6, 2)),
+               torch.nn.Parameter(torch.zeros(11))]
+    tparams[0].grad = torch.tensor(gs["a"].copy())
+    tparams[1].grad = torch.tensor(gs["b"].copy())
+
+    jgs = {k: jnp.asarray(v) for k, v in gs.items()}
+    for max_norm in (0.5, 1e6):        # clipping active / inactive
+        tnorm = torch.nn.utils.clip_grad_norm_(tparams, max_norm)
+        clipped, norm = clip_grad_norm(jgs, max_norm)
+        np.testing.assert_allclose(float(norm), float(tnorm), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(clipped["a"]),
+                                   tparams[0].grad.numpy(), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(clipped["b"]),
+                                   tparams[1].grad.numpy(), atol=1e-6)
+        # reset torch grads for the next max_norm
+        tparams[0].grad = torch.tensor(gs["a"].copy())
+        tparams[1].grad = torch.tensor(gs["b"].copy())
+        assert float(global_norm(jgs)) == pytest.approx(float(tnorm),
+                                                        rel=1e-6)
+
+
+def test_adamw_rejects_bad_hparams():
+    from tpu_dist.optim import AdamW
+
+    with pytest.raises(ValueError):
+        AdamW(betas=(1.0, 0.999))
+    with pytest.raises(ValueError):
+        AdamW(eps=0.0)

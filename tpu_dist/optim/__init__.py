@@ -1,5 +1,7 @@
 """tpu_dist.optim — pure-pytree optimizers."""
 
+from .adamw import Adam, AdamW
+from .clip import clip_grad_norm, global_norm
 from .sgd import SGD
 
-__all__ = ["SGD"]
+__all__ = ["SGD", "Adam", "AdamW", "clip_grad_norm", "global_norm"]

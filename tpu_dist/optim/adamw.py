@@ -1,0 +1,84 @@
+"""AdamW (+ plain Adam) — torch.optim.AdamW parity, pure-pytree.
+
+The reference uses only SGD (its workloads are small image classifiers,
+/root/reference/mpspawn_dist.py:64, example_mp.py:84-90); AdamW exists
+because tpu_dist's beyond-parity workload is LM training
+(models/transformer.py), where Adam-family optimizers are the default.
+
+Same pure-pytree contract as :class:`tpu_dist.optim.SGD`: ``init`` builds
+the state, ``update(grads, opt_state, params)`` is a pure function, so the
+whole update fuses into the jitted train step (and shards under the DDP
+wrapper's ZeRO-1 option, which is optimizer-agnostic).
+
+Update rule (torch semantics):
+
+    m   = b1*m + (1-b1)*g;     v = b2*v + (1-b2)*g^2
+    mh  = m / (1 - b1^t);      vh = v / (1 - b2^t)
+    p  -= lr * weight_decay * p                 (decoupled, AdamW)
+    p  -= lr * mh / (sqrt(vh) + eps)
+
+``decoupled=False`` gives classic Adam (L2 folded into the gradient
+pre-moments, torch.optim.Adam's ``weight_decay`` semantics).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["AdamW", "Adam"]
+
+
+class AdamW:
+    def __init__(self, lr: float = 1e-3, betas=(0.9, 0.999),
+                 eps: float = 1e-8, weight_decay: float = 1e-2,
+                 decoupled: bool = True):
+        if not 0.0 <= betas[0] < 1.0 or not 0.0 <= betas[1] < 1.0:
+            raise ValueError(f"Invalid betas {betas}")
+        if eps <= 0.0:
+            raise ValueError(f"Invalid eps {eps}")
+        self.lr = lr
+        self.betas = tuple(betas)
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.decoupled = decoupled
+
+    def init(self, params) -> Dict[str, Any]:
+        zeros = lambda: jax.tree.map(jnp.zeros_like, params)
+        return {"m": zeros(), "v": zeros(),
+                "step": jnp.zeros((), jnp.int32)}
+
+    def update(self, grads, opt_state, params):
+        """Return ``(new_params, new_opt_state)``; pure function."""
+        b1, b2 = self.betas
+        t = opt_state["step"] + 1
+        # bias corrections in f32 (t is an int32 scalar on device)
+        c1 = 1.0 - b1 ** t.astype(jnp.float32)
+        c2 = 1.0 - b2 ** t.astype(jnp.float32)
+        wd = self.weight_decay
+
+        if wd and not self.decoupled:
+            grads = jax.tree.map(lambda g, p: g + wd * p, grads, params)
+
+        new_m = jax.tree.map(lambda m, g: b1 * m + (1.0 - b1) * g,
+                             opt_state["m"], grads)
+        new_v = jax.tree.map(lambda v, g: b2 * v + (1.0 - b2) * jnp.square(g),
+                             opt_state["v"], grads)
+
+        def step(p, m, v):
+            upd = (m / c1) / (jnp.sqrt(v / c2) + self.eps)
+            if wd and self.decoupled:
+                p = p - self.lr * wd * p             # AdamW decoupled decay
+            return p - self.lr * upd
+
+        new_params = jax.tree.map(step, params, new_m, new_v)
+        return new_params, {"m": new_m, "v": new_v, "step": t}
+
+
+def Adam(lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8,
+         weight_decay: float = 0.0) -> AdamW:
+    """torch.optim.Adam semantics: L2 weight decay folded into gradients."""
+    return AdamW(lr=lr, betas=betas, eps=eps, weight_decay=weight_decay,
+                 decoupled=False)

@@ -1,0 +1,35 @@
+"""Gradient clipping — torch.nn.utils.clip_grad_norm_ parity, pure-pytree.
+
+No reference counterpart (its scripts never clip); provided because global-
+norm clipping is standard for the LM workloads tpu_dist adds.  Pure
+function of the gradient pytree, so it fuses into the jitted step; under
+the DDP wrapper call it on the *averaged* gradients (inside a custom step)
+— the global norm is then identical on every replica, like torch DDP
+clipping after allreduce.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["clip_grad_norm", "global_norm"]
+
+
+def global_norm(grads) -> jax.Array:
+    """L2 norm over every leaf of the pytree (torch: total_norm)."""
+    leaves = jax.tree.leaves(grads)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                        for g in leaves))
+
+
+def clip_grad_norm(grads, max_norm: float):
+    """Scale ``grads`` so their global L2 norm is at most ``max_norm``.
+
+    Returns ``(clipped_grads, total_norm)`` — like torch's
+    ``clip_grad_norm_``, which returns the pre-clip norm.
+    """
+    norm = global_norm(grads)
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+    return jax.tree.map(lambda g: (g.astype(jnp.float32) * scale
+                                   ).astype(g.dtype), grads), norm

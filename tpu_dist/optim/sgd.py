@@ -59,16 +59,15 @@ class SGD:
                 return p - lr * g
             return jax.tree.map(step, params, grads), opt_state
 
-        def step(p, g, buf):
-            if wd:
-                g = g + wd * p
-            buf = mom * buf + (1.0 - damp) * g
-            d = g + mom * buf if self.nesterov else buf
-            return p - lr * d, buf
-
-        flat = jax.tree.map(step, params, grads, opt_state["momentum"])
-        new_params = jax.tree.map(lambda t: t[0], flat,
-                                  is_leaf=lambda t: isinstance(t, tuple))
-        new_buf = jax.tree.map(lambda t: t[1], flat,
-                               is_leaf=lambda t: isinstance(t, tuple))
+        if wd:
+            grads = jax.tree.map(lambda g, p: g + wd * p, grads, params)
+        new_buf = jax.tree.map(lambda buf, g: mom * buf + (1.0 - damp) * g,
+                               opt_state["momentum"], grads)
+        if self.nesterov:
+            new_params = jax.tree.map(
+                lambda p, g, buf: p - lr * (g + mom * buf),
+                params, grads, new_buf)
+        else:
+            new_params = jax.tree.map(lambda p, buf: p - lr * buf,
+                                      params, new_buf)
         return new_params, {"momentum": new_buf}
