@@ -8,6 +8,7 @@ from .ddp import (DistributedDataParallel, TrainState,
                   convert_sync_batchnorm)
 from .gspmd import (PartitionRules, TRANSFORMER_TP_RULES,
                     make_gspmd_train_step, shard_pytree)
+from .pipeline import PipelineParallel, PipeTrainState
 from .ring_attention import ring_self_attention, ulysses_self_attention
 
 # torch-style alias (the reference imports nn.parallel.DistributedDataParallel)
@@ -17,4 +18,5 @@ __all__ = ["DistributedDataParallel", "DDP", "TrainState",
            "convert_sync_batchnorm",
            "PartitionRules", "TRANSFORMER_TP_RULES",
            "make_gspmd_train_step", "shard_pytree",
+           "PipelineParallel", "PipeTrainState",
            "ring_self_attention", "ulysses_self_attention"]

@@ -1,0 +1,352 @@
+"""Pipeline parallelism (GPipe schedule) — the 'pipe' mesh axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2c: data-parallel
+only); this module exists because tpu_dist's mesh design treats pp as a
+first-class axis alongside dp/tp/sp (ProcessGroup accepts arbitrary
+axis_names), and the driver's multi-chip dry-run exercises it.
+
+TPU-first design — one SPMD program, not per-stage processes:
+
+- The transformer trunk is cut into S **stages of identical topology**
+  (``depth % S == 0``), so stage parameters can be **stacked** on a leading
+  axis of size S and sharded ``P('pipe')``: every device holds exactly its
+  stage's weights, and the stage function is the *same* traced program on
+  every device (SPMD), selected purely by the parameter shard it holds.
+- The GPipe schedule is a ``lax.scan`` over ``M + S - 1`` ticks.  Each tick
+  ``lax.ppermute``s the activation carry one hop down the pipe (stage i →
+  i+1 over ICI), stage 0 swaps in the next microbatch's embeddings, every
+  stage applies its block-stack, and the last stage's trunk outputs
+  accumulate into an on-device buffer via clamped ``dynamic_update`` writes
+  (early garbage writes land on slot 0 and are overwritten at tick S-1 —
+  no masks in the hot loop).
+- Embedding and LM head stay **replicated** (P()): each device traces the
+  same embed/head compute, but gradients flow only through the copies that
+  feed the pipe (embed on stage 0, head on the last stage).  The loss is
+  ``psum`` of the last-stage-masked local loss, so JAX's VMA autodiff
+  (see ddp.py) inserts exactly the right cross-stage gradient ``psum`` for
+  the replicated leaves — stage-stacked leaves are pipe-varying and get
+  **no** collective, their gradients are local by construction.
+- Composes with data parallelism on a ('data', 'pipe') mesh: the batch
+  shards over 'data', each data row runs an independent pipeline, and the
+  same VMA autodiff inserts the gradient allreduce over 'data' because the
+  loss is ``pmean``-ed over it.  The optimizer update runs inside the
+  ``shard_map``, so stage parameters *and their optimizer state* stay
+  sharded 1/S per device — pipeline parallelism gives ZeRO-style optimizer
+  sharding of the trunk for free.
+
+Backward through the schedule is the transpose of the scan: XLA reverses
+the ``ppermute`` direction and replays ticks in reverse — the standard
+bubble of (S-1)/(M + S - 1) idle ticks on both passes; raise
+``num_microbatches`` to amortize it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import nn
+
+__all__ = ["PipelineParallel", "PipeTrainState"]
+
+
+class PipeTrainState(NamedTuple):
+    """State threaded through the jitted pipeline step.
+
+    ``params`` / ``opt_state`` are two-key dicts: ``"repl"`` (embedding +
+    head, replicated) and ``"stages"`` (trunk blocks stacked on a leading
+    stage axis, sharded ``P('pipe')``)."""
+    params: Any
+    opt_state: Any
+    step: jnp.ndarray
+
+
+class _Embed(nn.Module):
+    """Token + learned positional embeddings (the model's own modules, so
+    parameter pytrees transfer 1:1 between pipeline and plain layouts)."""
+
+    def __init__(self, tok, pos):
+        super().__init__()
+        self.tok = tok
+        self.pos = pos
+
+    def forward(self, idx):
+        t = idx.shape[1]
+        return self.tok(idx) + self.pos(jnp.arange(t))
+
+
+class _Head(nn.Module):
+    """Final LayerNorm + LM head."""
+
+    def __init__(self, ln_f, head):
+        super().__init__()
+        self.ln_f = ln_f
+        self.head = head
+
+    def forward(self, x):
+        return self.head(self.ln_f(x))
+
+
+class PipelineParallel:
+    """GPipe-parallel training driver for :class:`~tpu_dist.models.TransformerLM`.
+
+    Usage::
+
+        pg = dist.init_process_group(axis_names=("pipe",))   # or (data, pipe)
+        pp = PipelineParallel(model, optimizer=optim.AdamW(3e-4),
+                              loss_fn=nn.CrossEntropyLoss(), group=pg,
+                              num_microbatches=8)
+        state = pp.init(seed=0)
+        state, metrics = pp.train_step(state, tokens, targets)
+
+    ``tokens``/``targets`` are ``(B, T)`` int arrays; ``B`` must divide by
+    ``num_microbatches`` (and by the data-axis size when present).
+    """
+
+    def __init__(self, model, optimizer=None, loss_fn=None, group=None,
+                 num_microbatches: Optional[int] = None,
+                 pipe_axis: str = "pipe", data_axis: Optional[str] = None,
+                 donate: bool = True):
+        if group is None:
+            from .. import dist as _dist
+            group = _dist.get_default_group()
+        if pipe_axis not in group.mesh.axis_names:
+            raise ValueError(f"mesh {group.mesh.axis_names} has no "
+                             f"{pipe_axis!r} axis")
+        if data_axis is None and len(group.mesh.axis_names) > 1:
+            others = [a for a in group.mesh.axis_names if a != pipe_axis]
+            if len(others) == 1:
+                data_axis = others[0]
+            else:
+                raise ValueError("pass data_axis= explicitly on a >2-D mesh")
+        if getattr(model, "sequence_axis", None) is not None:
+            raise ValueError("pipeline parallelism microbatches over the "
+                             "batch dim; build the model without "
+                             "sequence_axis (pp x sp needs a 3-D mesh recipe)")
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.group = group
+        self.pipe_axis = pipe_axis
+        self.data_axis = data_axis
+        self.donate = donate
+        self.num_stages = group.mesh.shape[pipe_axis]
+        if model.depth % self.num_stages:
+            raise ValueError(f"depth {model.depth} not divisible by "
+                             f"{self.num_stages} pipeline stages")
+        self.blocks_per_stage = model.depth // self.num_stages
+        self.num_microbatches = num_microbatches or self.num_stages
+        # canonical stage program: the first blocks_per_stage blocks.  Module
+        # objects hold topology only (nn/module.py design), so one stage's
+        # module tree serves as the traced program for every stage — which
+        # weights it runs with is decided by the P('pipe') parameter shard.
+        self._stage = nn.Sequential(*[getattr(model, f"block{i}")
+                                      for i in range(self.blocks_per_stage)])
+        self._embed = _Embed(model.tok, model.pos)
+        self._head = _Head(model.ln_f, model.head)
+        self._canon_paths = None  # stage-relative -> block0-rooted paths
+        self._train_step = None
+
+    # -- parameter layout ------------------------------------------------------
+
+    def _stage_paths(self):
+        """Canonical stage-relative leaf paths ("0.ln1", "1.mlp.0", ...) and
+        their block-index/suffix decomposition."""
+        if self._canon_paths is None:
+            self._stage._assign_paths()
+            paths = []
+            for path, mod in self._stage.named_modules():
+                if type(mod).create_params is not nn.Module.create_params:
+                    j, _, suffix = path.partition(".")
+                    paths.append((path, int(j), suffix))
+            self._canon_paths = paths
+        return self._canon_paths
+
+    def pack_params(self, model_params):
+        """Plain ``model.init()`` pytree → pipeline layout ``{"repl",
+        "stages"}`` (stage leaves stacked on a leading S axis)."""
+        s, k = self.num_stages, self.blocks_per_stage
+        stages = {}
+        for canon, j, suffix in self._stage_paths():
+            def src(stage):
+                base = f"block{stage * k + j}"
+                return model_params[f"{base}.{suffix}" if suffix else base]
+            names = src(0).keys()
+            stages[canon] = {n: jnp.stack([src(st)[n] for st in range(s)])
+                            for n in names}
+        repl = {"embed": {"tok": model_params["tok"],
+                          "pos": model_params["pos"]},
+                "head": {"ln_f": model_params["ln_f"],
+                         "head": model_params["head"]}}
+        return {"repl": repl, "stages": stages}
+
+    def unpack_params(self, pipe_params):
+        """Inverse of :meth:`pack_params` — e.g. to checkpoint in the plain
+        layout or hand weights to an unsharded model for decoding."""
+        k = self.blocks_per_stage
+        out = {"tok": pipe_params["repl"]["embed"]["tok"],
+               "pos": pipe_params["repl"]["embed"]["pos"],
+               "ln_f": pipe_params["repl"]["head"]["ln_f"],
+               "head": pipe_params["repl"]["head"]["head"]}
+        for canon, j, suffix in self._stage_paths():
+            stacked = pipe_params["stages"][canon]
+            for st in range(self.num_stages):
+                base = f"block{st * k + j}"
+                path = f"{base}.{suffix}" if suffix else base
+                out[path] = {n: v[st] for n, v in stacked.items()}
+        return out
+
+    def _param_specs(self, params):
+        """PartitionSpec pytree: stages P('pipe') on the stacked axis,
+        everything else replicated."""
+        pipe = self.pipe_axis
+        return {"repl": jax.tree.map(lambda _: P(), params["repl"]),
+                "stages": jax.tree.map(lambda _: P(pipe), params["stages"])}
+
+    def _opt_specs(self, opt_state):
+        """Optimizer-state specs: leaves mirroring stacked stage params keep
+        P('pipe'); scalars (step counters) replicate."""
+        pipe = self.pipe_axis
+
+        def split(sub, stacked):
+            return jax.tree.map(
+                lambda l: P(pipe) if (stacked and getattr(l, "ndim", 0) >= 1)
+                else P(), sub)
+
+        return {"repl": split(opt_state["repl"], False),
+                "stages": split(opt_state["stages"], True)}
+
+    # -- state -----------------------------------------------------------------
+
+    def init(self, seed: int = 0) -> PipeTrainState:
+        """Deterministic state build: plain ``model.init`` then repack, so
+        pipeline training starts from bit-identical weights to a
+        single-device run with the same seed."""
+        params = self.pack_params(self.model.init(jax.random.key(seed)))
+        if self.optimizer is None:
+            opt_state = {"repl": {}, "stages": {}}
+        else:
+            opt_state = {"repl": self.optimizer.init(params["repl"]),
+                         "stages": self.optimizer.init(params["stages"])}
+        state = PipeTrainState(params, opt_state, jnp.zeros((), jnp.int32))
+        return jax.tree.map(jax.device_put, state, self.state_shardings(state))
+
+    def state_shardings(self, state: PipeTrainState) -> PipeTrainState:
+        """NamedSharding pytree mirroring ``state``'s placement (for
+        ``tpu_dist.checkpoint.restore(sharding=...)``)."""
+        mesh = self.group.mesh
+        spec = PipeTrainState(self._param_specs(state.params),
+                              self._opt_specs(state.opt_state), P())
+        return jax.tree.map(lambda sp: NamedSharding(mesh, sp), spec,
+                            is_leaf=lambda x: isinstance(x, P))
+
+    # -- compiled step ---------------------------------------------------------
+
+    def _build_train_step(self):
+        stage, embed, head = self._stage, self._embed, self._head
+        loss_fn, optimizer = self.loss_fn, self.optimizer
+        pipe, data = self.pipe_axis, self.data_axis
+        s, m = self.num_stages, self.num_microbatches
+        vocab = self.model.vocab_size
+
+        def local_step(state: PipeTrainState, x, y):
+            params, opt_state, step = state
+            idx = lax.axis_index(pipe)
+            is_last = idx == s - 1
+            b_loc, t = x.shape
+            mb = b_loc // m
+            x_mb = x.reshape(m, mb, t)
+
+            def trunk(repl_p, stages_p, x_mb):
+                """GPipe loop → last-stage trunk outputs (m, mb, t, d)."""
+                stage_local = jax.tree.map(lambda v: v[0], stages_p)
+                perm = [(i, (i + 1) % s) for i in range(s)]
+
+                def tick(carry, tick_t):
+                    h, out = carry
+                    prev = lax.ppermute(h, pipe, perm)
+                    inj = embed.apply(repl_p["embed"],
+                                      x_mb[jnp.minimum(tick_t, m - 1)])
+                    h = jnp.where(idx == 0, inj, prev)
+                    if self.model.remat:
+                        # honor the model's per-block remat policy: recompute
+                        # the stage's activations during backward instead of
+                        # holding every tick's intermediates across the scan
+                        h = jax.checkpoint(stage.apply)(stage_local, h)
+                    else:
+                        h = stage.apply(stage_local, h)
+                    # clamped write: ticks < s-1 land on slot 0 and are
+                    # overwritten at tick s-1, so no validity mask is needed
+                    slot = jnp.clip(tick_t - (s - 1), 0, m - 1)
+                    out = lax.dynamic_update_index_in_dim(out, h, slot, 0)
+                    return (h, out), None
+
+                dim = self.model.tok.embedding_dim
+                # the carry crosses stages (ppermute), mixes with the
+                # pipe-varying stage index, and holds data-sharded
+                # activations — it must start varying over every mesh axis
+                # the tick output is varying over, or scan rejects the body
+                axes = (pipe,) if data is None else (data, pipe)
+                h0 = jnp.zeros(x_mb.shape[1:] + (dim,), jnp.float32)
+                out0 = jnp.zeros((m,) + h0.shape, jnp.float32)
+                for ax in axes:
+                    h0 = lax.pcast(h0, ax, to="varying")
+                    out0 = lax.pcast(out0, ax, to="varying")
+                (_, out), _ = lax.scan(tick, (h0, out0), jnp.arange(m + s - 1))
+                return out
+
+            def loss_of(p):
+                out = trunk(p["repl"], p["stages"], x_mb)
+                logits = head.apply(p["repl"]["head"],
+                                    out.reshape(b_loc, t, -1))
+                local = loss_fn(logits.reshape(-1, vocab), y.reshape(-1))
+                correct = (logits.argmax(-1) == y).sum()
+                # only the last stage's buffer holds the real trunk output;
+                # psum of the masked loss broadcasts it pipe-invariant, and
+                # its VMA transpose routes gradient only into that copy
+                loss = lax.psum(jnp.where(is_last, local, 0.0), pipe)
+                correct = lax.psum(jnp.where(is_last, correct, 0), pipe)
+                if data is not None:
+                    loss = lax.pmean(loss, data)
+                    correct = lax.psum(correct, data)
+                return loss, correct
+
+            (loss, correct), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(params)
+
+            new_repl, opt_repl = optimizer.update(
+                grads["repl"], opt_state["repl"], params["repl"])
+            new_stages, opt_stages = optimizer.update(
+                grads["stages"], opt_state["stages"], params["stages"])
+            new_params = {"repl": new_repl, "stages": new_stages}
+            new_opt = {"repl": opt_repl, "stages": opt_stages}
+
+            new_state = PipeTrainState(new_params, new_opt, step + 1)
+            return new_state, {"loss": loss, "correct": correct}
+
+        def specs_of(state):
+            return PipeTrainState(self._param_specs(state.params),
+                                  self._opt_specs(state.opt_state), P())
+
+        def build(state):
+            state_spec = specs_of(state)
+            batch_spec = P(data) if data is not None else P()
+            fn = jax.shard_map(local_step, mesh=self.group.mesh,
+                               in_specs=(state_spec, batch_spec, batch_spec),
+                               out_specs=(state_spec, P()))
+            return jax.jit(fn, donate_argnums=(0,) if self.donate else ())
+
+        return build
+
+    def train_step(self, state: PipeTrainState, x, y):
+        """One fused pipeline step (all S stages, all M microbatches, grads,
+        update) → ``(new_state, {"loss", "correct"})``."""
+        if self.optimizer is None or self.loss_fn is None:
+            raise ValueError("train_step requires optimizer= and loss_fn=")
+        if self._train_step is None:
+            self._train_step = self._build_train_step()(state)
+        return self._train_step(state, x, y)
