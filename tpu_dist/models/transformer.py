@@ -18,21 +18,42 @@ import jax
 import jax.numpy as jnp
 
 from .. import nn
+from ..nn.module import current_context
 
 __all__ = ["TransformerLM", "TransformerBlock"]
 
 
+def _run_capturing_state(block, x):
+    """Run ``block(x)`` with the apply-context's state-update sink swapped
+    for a fresh dict, returning ``(output, captured_updates)`` — so a
+    ``jax.checkpoint``-wrapped block's state writes become explicit remat
+    outputs instead of tracer leaks into the outer trace."""
+    ctx = current_context()
+    if ctx is None or ctx.new_state is None:
+        return block(x), {}
+    saved = ctx.new_state
+    ctx.new_state = {}
+    try:
+        out = block(x)
+        updates = ctx.new_state
+    finally:
+        ctx.new_state = saved
+    return out, updates
+
+
 class TransformerBlock(nn.Module):
     def __init__(self, dim: int, num_heads: int, causal: bool = True,
-                 sequence_axis: Optional[str] = None, mode: str = "ring"):
+                 sequence_axis: Optional[str] = None, mode: str = "ring",
+                 mlp: Optional[nn.Module] = None):
         super().__init__()
         self.ln1 = nn.LayerNorm(dim)
         self.attn = nn.MultiheadSelfAttention(dim, num_heads, causal=causal,
                                               sequence_axis=sequence_axis,
                                               mode=mode)
         self.ln2 = nn.LayerNorm(dim)
-        self.mlp = nn.Sequential(nn.Linear(dim, 4 * dim), nn.GELU(),
-                                 nn.Linear(4 * dim, dim))
+        # mlp override: e.g. an nn.MoELayer for mixture-of-experts blocks
+        self.mlp = mlp if mlp is not None else nn.Sequential(
+            nn.Linear(dim, 4 * dim), nn.GELU(), nn.Linear(4 * dim, dim))
 
     def forward(self, x):
         x = x + self.attn(self.ln1(x))
@@ -54,16 +75,29 @@ class TransformerLM(nn.Module):
     def __init__(self, vocab_size: int, dim: int = 128, depth: int = 2,
                  num_heads: int = 4, max_seq_len: int = 1024,
                  causal: bool = True, sequence_axis: Optional[str] = None,
-                 mode: str = "ring", remat: bool = False):
+                 mode: str = "ring", remat: bool = False,
+                 num_experts: int = 0, moe_top_k: int = 2,
+                 moe_every: int = 1, moe_capacity_factor: float = 1.25):
+        """``num_experts > 0`` makes every ``moe_every``-th block's MLP a
+        routed :class:`~tpu_dist.nn.MoELayer` (expert-parallel under
+        :data:`~tpu_dist.parallel.MOE_EP_RULES`); aux load-balance losses
+        surface in the model state, see nn/moe.py."""
         super().__init__()
+        if num_experts > 0 and moe_every < 1:
+            raise ValueError(f"moe_every must be >= 1, got {moe_every}")
         self.vocab_size = vocab_size
         self.max_seq_len = max_seq_len
+        self.num_experts = num_experts
         self.tok = nn.Embedding(vocab_size, dim)
         self.pos = nn.Embedding(max_seq_len, dim)
         for i in range(depth):
+            moe = (num_experts > 0 and i % moe_every == moe_every - 1)
             setattr(self, f"block{i}", TransformerBlock(
                 dim, num_heads, causal=causal,
-                sequence_axis=sequence_axis, mode=mode))
+                sequence_axis=sequence_axis, mode=mode,
+                mlp=nn.MoELayer(dim, num_experts, top_k=moe_top_k,
+                                capacity_factor=moe_capacity_factor)
+                if moe else None))
         self.depth = depth
         self.causal = causal
         self.sequence_axis = sequence_axis
@@ -95,8 +129,16 @@ class TransformerLM(nn.Module):
             if use_remat:
                 # params reach the block through the apply() context as
                 # closed-over tracers; jax.checkpoint differentiates through
-                # closures, so no explicit param plumbing is needed
-                x = jax.checkpoint(lambda y, _b=block: _b(y))(x)
+                # closures, so no explicit param plumbing is needed.  State
+                # updates (MoE aux losses) must NOT be written to the outer
+                # context from inside the remat sub-trace — that leaks
+                # tracers — so they are captured and returned as explicit
+                # checkpoint outputs, then re-published outside.
+                x, updates = jax.checkpoint(
+                    lambda y, _b=block: _run_capturing_state(_b, y))(x)
+                ctx = current_context()
+                for path, val in updates.items():
+                    ctx.put_state(path, val)
             else:
                 x = block(x)
         return self.head(self.ln_f(x))

@@ -8,6 +8,7 @@ from .layers import (AdaptiveAvgPool2d, AvgPool2d, BatchNorm2d, Conv2d,
                      Dropout, Embedding, Flatten, GELU, Identity, LayerNorm,
                      Linear, MaxPool2d, ReLU)
 from .loss import CrossEntropyLoss
+from .moe import MoELayer
 from .module import Module, Sequential
 
 __all__ = [
@@ -16,6 +17,6 @@ __all__ = [
     "ReLU", "Flatten", "Dropout", "BatchNorm2d", "Identity",
     "Embedding", "LayerNorm", "GELU",
     "MultiheadSelfAttention", "scaled_dot_product_attention",
-    "attention_impl",
+    "attention_impl", "MoELayer",
     "CrossEntropyLoss",
 ]

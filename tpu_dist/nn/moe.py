@@ -1,0 +1,158 @@
+"""Mixture-of-Experts layer — expert parallelism the GSPMD way.
+
+No reference counterpart (SURVEY.md §2: the reference is data-parallel image
+classifiers); this exists because tpu_dist treats the 'expert' mesh axis as
+first-class alongside dp/tp/pp/sp, and the driver's multi-chip dry-run
+exercises an ep sharding.
+
+TPU-first design — routing as dense einsums, not gather/scatter:
+
+- Expert FFN weights are **stacked** on a leading expert axis: ``w1 (E, d,
+  h)``, ``w2 (E, h, d)``.  Under expert parallelism that axis is sharded
+  ``P('expert')`` (see :data:`MOE_EP_RULES`) and every expert matmul is a
+  batched einsum the MXU tiles directly.
+- Token routing is the GShard/Switch capacity formulation: top-k gating
+  probabilities become dense **dispatch/combine tensors** ``(N, E, C)``
+  built from one-hots and a cumsum position assignment — static shapes, no
+  data-dependent gather, so the whole layer jits and the XLA SPMD
+  partitioner inserts the token all-to-alls purely from the shardings
+  (einsum ``nec,nd->ecd`` with the output sharded over 'expert' IS the
+  dispatch all-to-all).  Tokens beyond an expert's capacity ``C =
+  ceil(k*N/E * capacity_factor)`` are dropped — their combine weights are
+  zero, so they pass through the surrounding residual unchanged.
+- The Switch **load-balancing auxiliary loss** ``E * sum_e f_e * p_e``
+  (fraction of tokens routed to e times mean router probability of e) is
+  published through the module-state mechanism (``state["aux_loss"]``):
+  it is a traced value in ``new_state``, so a trainer that adds
+  ``coeff * new_state[path]["aux_loss"]`` to its objective gets gradients
+  through the router exactly as if the layer had returned it.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .module import Module
+from . import init as init_lib
+
+__all__ = ["MoELayer"]
+
+
+class MoELayer(Module):
+    """Top-k routed mixture of expert FFNs (drop-in for a transformer MLP).
+
+    Args:
+        dim: model width.
+        num_experts: E, the expert count (shard over 'expert' for ep).
+        hidden: expert FFN hidden width (default ``4 * dim``).
+        top_k: experts consulted per token (1 = Switch, 2 = GShard default).
+        capacity_factor: slack multiplier on the perfectly-balanced
+            per-expert token budget; tokens past capacity are dropped.
+        normalize_gates: renormalize the k selected gate values to sum to 1
+            (GShard semantics); off uses raw softmax probabilities (Switch).
+    """
+
+    def __init__(self, dim: int, num_experts: int, hidden: int = 0,
+                 top_k: int = 2, capacity_factor: float = 1.25,
+                 normalize_gates: bool = True):
+        super().__init__()
+        if num_experts < 2:
+            raise ValueError(f"num_experts must be >= 2, got {num_experts}")
+        if not 1 <= top_k <= num_experts:
+            raise ValueError(f"top_k {top_k} not in [1, {num_experts}]")
+        self.dim = dim
+        self.num_experts = num_experts
+        self.hidden = hidden or 4 * dim
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.normalize_gates = normalize_gates
+
+    def create_params(self, key):
+        kr, k1, k2 = jax.random.split(key, 3)
+        e, d, h = self.num_experts, self.dim, self.hidden
+
+        def expert_uniform(k, shape, fan_in):
+            # kaiming_uniform per expert: stacked (E, in, out) weights get
+            # the same bound a (in, out) Linear would (init.calculate_fan
+            # only knows 2-D/4-D shapes)
+            bound = math.sqrt(6.0 / fan_in)
+            return init_lib.uniform(k, shape, -bound, bound)
+
+        return {
+            "router": init_lib.kaiming_uniform(kr, (d, e)),
+            "w1": expert_uniform(k1, (e, d, h), d),
+            "b1": jnp.zeros((e, h)),
+            "w2": expert_uniform(k2, (e, h, d), h),
+            "b2": jnp.zeros((e, d)),
+        }
+
+    def create_state(self):
+        return {"aux_loss": jnp.zeros(())}
+
+    def _capacity(self, n_tokens: int) -> int:
+        c = math.ceil(self.top_k * n_tokens / self.num_experts
+                      * self.capacity_factor)
+        # an expert can receive each token at most once (top-k experts are
+        # distinct), so capacity beyond n_tokens only pads the einsums
+        return max(1, min(c, n_tokens))
+
+    def forward(self, x):
+        from .module import _ctx
+        p = _ctx().get_params(self._path)
+        e, k = self.num_experts, self.top_k
+        lead, d = x.shape[:-1], x.shape[-1]
+        xt = x.reshape(-1, d)
+        n = xt.shape[0]
+        c = self._capacity(n)
+
+        probs = jax.nn.softmax(xt @ p["router"], axis=-1)        # (N, E)
+        gate_vals, gate_idx = lax.top_k(probs, k)                # (N, k)
+        if self.normalize_gates and k > 1:
+            gate_vals = gate_vals / jnp.maximum(
+                gate_vals.sum(-1, keepdims=True), 1e-9)
+
+        # slot assignment: flatten the k choices in priority order (all
+        # first choices, then all second choices, ...) and cumsum the
+        # one-hots — each (choice, token) gets its arrival index at the
+        # chosen expert; indices >= capacity are dropped
+        oh = jax.nn.one_hot(gate_idx.T, e, dtype=xt.dtype)       # (k, N, E)
+        flat = oh.reshape(k * n, e)
+        pos = (jnp.cumsum(flat, axis=0) - flat)                  # (k*N, E)
+        pos = (pos * flat).sum(-1).reshape(k, n)                 # (k, N)
+        keep = (pos < c).astype(xt.dtype)                        # (k, N)
+
+        slot_oh = jax.nn.one_hot(pos.astype(jnp.int32), c,
+                                 dtype=xt.dtype)                 # (k, N, C)
+        # (k, N, E, C) collapsed over k → dispatch/combine (N, E, C)
+        dispatch = jnp.einsum("kne,knc,kn->nec", oh, slot_oh, keep)
+        combine = jnp.einsum("kne,knc,kn->nec", oh, slot_oh,
+                             keep * gate_vals.T)
+
+        xs = jnp.einsum("nec,nd->ecd", dispatch, xt)             # per-expert
+        hdn = jax.nn.gelu(jnp.einsum("ecd,edh->ech", xs, p["w1"])
+                          + p["b1"][:, None, :])
+        out = jnp.einsum("ech,ehd->ecd", hdn, p["w2"]) + p["b2"][:, None, :]
+        # dropped tokens have all-zero combine rows → output 0; the
+        # surrounding residual connection passes them through unchanged
+        y = jnp.einsum("nec,ecd->nd", combine, out)
+
+        # Switch load-balance loss on first-choice assignments
+        frac = jnp.mean(jax.nn.one_hot(gate_idx[:, 0], e, dtype=xt.dtype),
+                        axis=0)
+        mean_prob = probs.mean(0)
+        self._put_aux(e * jnp.sum(frac * mean_prob))
+        return y.reshape(*lead, d)
+
+    def _put_aux(self, aux) -> None:
+        from .module import current_context
+        ctx = current_context()
+        if ctx is not None and ctx.state is not None:
+            ctx.put_state(self._path, {"aux_loss": aux})
+
+    def __repr__(self):
+        return (f"MoELayer({self.dim}, num_experts={self.num_experts}, "
+                f"hidden={self.hidden}, top_k={self.top_k})")

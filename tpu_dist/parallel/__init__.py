@@ -6,7 +6,7 @@ axis_names/mesh_shape)."""
 
 from .ddp import (DistributedDataParallel, TrainState,
                   convert_sync_batchnorm)
-from .gspmd import (PartitionRules, TRANSFORMER_TP_RULES,
+from .gspmd import (MOE_EP_RULES, PartitionRules, TRANSFORMER_TP_RULES,
                     make_gspmd_train_step, shard_pytree)
 from .pipeline import PipelineParallel, PipeTrainState
 from .ring_attention import ring_self_attention, ulysses_self_attention
@@ -16,7 +16,7 @@ DDP = DistributedDataParallel
 
 __all__ = ["DistributedDataParallel", "DDP", "TrainState",
            "convert_sync_batchnorm",
-           "PartitionRules", "TRANSFORMER_TP_RULES",
+           "PartitionRules", "TRANSFORMER_TP_RULES", "MOE_EP_RULES",
            "make_gspmd_train_step", "shard_pytree",
            "PipelineParallel", "PipeTrainState",
            "ring_self_attention", "ulysses_self_attention"]

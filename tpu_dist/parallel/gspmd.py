@@ -28,7 +28,7 @@ import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 __all__ = ["PartitionRules", "shard_pytree", "make_gspmd_train_step",
-           "TRANSFORMER_TP_RULES"]
+           "TRANSFORMER_TP_RULES", "MOE_EP_RULES"]
 
 
 class PartitionRules:
@@ -73,6 +73,15 @@ TRANSFORMER_TP_RULES = PartitionRules([
     (r"\['tok'\].*weight", P("model", None)),
 ])
 
+# Expert parallelism over an 'expert' mesh axis: every stacked MoE leaf
+# (w1/b1/w2/b2, leading dim = num_experts; see nn/moe.py) shards its expert
+# axis; the router and everything else replicate.  The dispatch/combine
+# einsums then partition over 'expert' and XLA inserts the token
+# all-to-alls the GShard paper wires by hand.
+MOE_EP_RULES = PartitionRules([
+    (r"mlp'\]\['[wb][12]'\]", P("expert")),
+])
+
 
 def shard_pytree(tree, mesh, rules: Optional[PartitionRules] = None):
     """``device_put`` every leaf onto ``mesh`` per ``rules`` (default:
@@ -86,32 +95,59 @@ def shard_pytree(tree, mesh, rules: Optional[PartitionRules] = None):
         is_leaf=lambda x: x is None)
 
 
-def make_gspmd_train_step(model, loss_fn, optimizer,
-                          donate: bool = True) -> Callable:
+def make_gspmd_train_step(model, loss_fn, optimizer, donate: bool = True,
+                          aux_loss_coeff: float = 0.0) -> Callable:
     """Build the jitted GSPMD step: ordinary single-device code, sharded by
     its inputs.  Callers place params/opt_state with :func:`shard_pytree`
     and the batch with a ``P('data', ...)`` sharding; returns
-    ``step(params, opt_state, x, y) -> (params, opt_state, metrics)``.
+    ``step(params, opt_state, x, y) -> (params, opt_state, metrics)`` —
+    or, when the model carries mutable state (BatchNorm stats, MoE aux
+    losses), ``step(params, opt_state, mstate, x, y) -> (params, opt_state,
+    new_mstate, metrics)``.
+
+    ``aux_loss_coeff``: weight on the sum of every ``aux_loss`` entry the
+    state carries (MoE load balancing, nn/moe.py) — the entries are traced
+    values of the same forward, so gradients flow through the routers.
 
     NOTE vs the shard_map DDP wrapper: under GSPMD, batch statistics (e.g.
     BatchNorm) are computed over the **global** batch — sync-BN semantics —
     because the program is written globally.  The shard_map wrapper is the
     one matching torch DDP's per-replica BN exactly.
     """
+    has_state = model.has_state()
 
-    def step(params, opt_state, x, y):
-        def loss_of(p):
-            # dense attention under GSPMD: XLA's SPMD partitioner cannot cut
-            # a Pallas custom call, so the flash kernel must not be
-            # auto-dispatched inside a sharded jit (see nn.attention)
-            from ..nn.attention import attention_impl
-            with attention_impl("dense"):
-                out = model.apply(p, x)
-            return loss_fn(out, y), out
+    def run_model(p, ms, x):
+        # dense attention under GSPMD: XLA's SPMD partitioner cannot cut
+        # a Pallas custom call, so the flash kernel must not be
+        # auto-dispatched inside a sharded jit (see nn.attention)
+        from ..nn.attention import attention_impl
+        with attention_impl("dense"):
+            if has_state:
+                return model.apply(p, x, state=ms, training=True)
+            return model.apply(p, x), ms
 
-        (loss, out), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
+    def objective(p, ms, x, y):
+        out, new_ms = run_model(p, ms, x)
+        loss = loss_fn(out, y)
+        aux = sum((v["aux_loss"] for v in new_ms.values()
+                   if isinstance(v, dict) and "aux_loss" in v),
+                  start=0.0) if has_state else 0.0
+        return loss + aux_loss_coeff * aux, (loss, out, new_ms)
+
+    def stateless_step(params, opt_state, x, y):
+        (_, (loss, out, _)), grads = jax.value_and_grad(
+            objective, has_aux=True)(params, {}, x, y)
         new_params, new_opt = optimizer.update(grads, opt_state, params)
         correct = (out.argmax(-1) == y).sum()
         return new_params, new_opt, {"loss": loss, "correct": correct}
 
-    return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+    def stateful_step(params, opt_state, mstate, x, y):
+        (_, (loss, out, new_ms)), grads = jax.value_and_grad(
+            objective, has_aux=True)(params, mstate, x, y)
+        new_params, new_opt = optimizer.update(grads, opt_state, params)
+        correct = (out.argmax(-1) == y).sum()
+        return new_params, new_opt, new_ms, {"loss": loss,
+                                             "correct": correct}
+
+    fn = stateful_step if has_state else stateless_step
+    return jax.jit(fn, donate_argnums=(0, 1) if donate else ())
