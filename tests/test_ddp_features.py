@@ -87,6 +87,29 @@ class TestZero1:
         assert s2.opt_state["momentum"]["flat"].sharding.spec == \
             P(pg.axis_name)
 
+    def test_zero1_scalar_opt_state_leaves(self, pg):
+        """Optimizers with scalar step counters (AdamW, scheduled-lr SGD)
+        under ZeRO-1: scalars replicate, rank>=1 leaves shard 1/world."""
+        x, y = _batch(32)
+        for opt in (optim.AdamW(lr=1e-3),
+                    optim.SGD(lr=optim.step_lr(0.05, step_size=2),
+                              momentum=0.9)):
+            plain = DDP(ConvNet(), optimizer=opt,
+                        loss_fn=nn.CrossEntropyLoss(), group=pg,
+                        donate=False)
+            z1 = DDP(ConvNet(), optimizer=opt,
+                     loss_fn=nn.CrossEntropyLoss(), group=pg, donate=False,
+                     shard_optimizer=True)
+            sp, sz = plain.init(seed=0), z1.init(seed=0)
+            assert sz.opt_state["step"].sharding.spec == P()
+            for _ in range(3):
+                sp, _ = plain.train_step(sp, x, y)
+                sz, _ = z1.train_step(sz, x, y)
+            assert int(sz.opt_state["step"]) == 3
+            jax.tree.map(lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-6),
+                sp.params, sz.params)
+
     def test_zero1_with_accum(self, pg):
         x, y = _batch(64)
         plain = _mk(pg)

@@ -1,0 +1,126 @@
+"""LR schedules vs torch.optim.lr_scheduler — sequence-exact parity.
+
+Each tpu_dist schedule is a pure function f(step) -> lr; torch schedulers
+mutate optimizer.param_groups per .step().  Parity: f(i) equals the torch
+scheduler's lr during step i, for every i in a window covering all the
+schedule's regimes."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from tpu_dist import optim
+
+LR = 0.1
+
+
+def _torch_lrs(make_sched, steps, lr=LR):
+    p = [torch.nn.Parameter(torch.zeros(1))]
+    opt = torch.optim.SGD(p, lr=lr)
+    sched = make_sched(opt)
+    out = []
+    for _ in range(steps):
+        out.append(opt.param_groups[0]["lr"])
+        opt.step()
+        sched.step()
+    return np.asarray(out, np.float64)
+
+
+@pytest.mark.parametrize("ours,theirs,steps", [
+    (optim.step_lr(LR, step_size=3, gamma=0.5),
+     lambda o: torch.optim.lr_scheduler.StepLR(o, step_size=3, gamma=0.5), 10),
+    (optim.multistep_lr(LR, milestones=[2, 5, 9], gamma=0.3),
+     lambda o: torch.optim.lr_scheduler.MultiStepLR(o, milestones=[2, 5, 9],
+                                                    gamma=0.3), 12),
+    (optim.exponential_lr(LR, gamma=0.9),
+     lambda o: torch.optim.lr_scheduler.ExponentialLR(o, gamma=0.9), 8),
+    (optim.linear_lr(LR, start_factor=0.25, end_factor=1.0, total_iters=4),
+     lambda o: torch.optim.lr_scheduler.LinearLR(
+         o, start_factor=0.25, end_factor=1.0, total_iters=4), 8),
+    (optim.cosine_annealing_lr(LR, t_max=6, eta_min=0.01),
+     lambda o: torch.optim.lr_scheduler.CosineAnnealingLR(
+         o, T_max=6, eta_min=0.01), 7),
+    (optim.constant_lr(LR, factor=0.5, total_iters=3),
+     lambda o: torch.optim.lr_scheduler.ConstantLR(o, factor=0.5,
+                                                   total_iters=3), 6),
+])
+def test_schedule_matches_torch(ours, theirs, steps):
+    want = _torch_lrs(theirs, steps)
+    got = np.asarray([float(ours(i)) for i in range(steps)])
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_sequential_matches_torch():
+    ours = optim.sequential_lr(
+        [optim.constant_lr(LR, factor=0.1, total_iters=100),
+         optim.exponential_lr(LR, gamma=0.5)], milestones=[4])
+    want = _torch_lrs(lambda o: torch.optim.lr_scheduler.SequentialLR(
+        o, [torch.optim.lr_scheduler.ConstantLR(o, factor=0.1,
+                                                total_iters=100),
+            torch.optim.lr_scheduler.ExponentialLR(o, gamma=0.5)],
+        milestones=[4]), 10)
+    got = np.asarray([float(ours(i)) for i in range(10)])
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_sequential_validates():
+    with pytest.raises(ValueError, match="milestones"):
+        optim.sequential_lr([optim.constant_lr(LR)], milestones=[1])
+
+
+def test_warmup_cosine_shape():
+    s = optim.warmup_cosine(peak_lr=1.0, warmup_steps=10, total_steps=110,
+                            end_lr=0.1)
+    lrs = np.asarray([float(s(i)) for i in range(120)])
+    np.testing.assert_allclose(lrs[0], 0.0)
+    np.testing.assert_allclose(lrs[10], 1.0)            # peak after warmup
+    assert (np.diff(lrs[:11]) > 0).all()                # monotone warmup
+    assert (np.diff(lrs[10:110]) <= 1e-9).all()         # monotone decay
+    np.testing.assert_allclose(lrs[110:], 0.1, atol=1e-6)
+
+
+def test_scheduled_sgd_steps_lr(rng):
+    """SGD(lr=schedule): each update uses lr(i) — verify against manual."""
+    sched = optim.step_lr(0.5, step_size=2, gamma=0.1)
+    opt = optim.SGD(lr=sched, momentum=0.9)
+    w0 = rng.standard_normal(4).astype(np.float32)
+    params = {"w": jnp.asarray(w0)}
+    opt_state = opt.init(params)
+    assert int(opt_state["step"]) == 0
+
+    g = np.ones(4, np.float32)
+    manual = w0.copy()
+    buf = np.zeros(4, np.float32)
+    for i in range(5):
+        params, opt_state = opt.update({"w": jnp.asarray(g)}, opt_state,
+                                       params)
+        buf = 0.9 * buf + g
+        manual -= float(sched(i)) * buf
+        np.testing.assert_allclose(np.asarray(params["w"]), manual,
+                                   atol=1e-6, err_msg=f"step {i}")
+    assert int(opt_state["step"]) == 5
+
+
+def test_scheduled_adamw_matches_torch(rng):
+    """AdamW(lr=cosine schedule) over 6 steps == torch AdamW + scheduler."""
+    t_max = 4
+    w0 = rng.standard_normal((3, 2)).astype(np.float32)
+    tparam = torch.nn.Parameter(torch.tensor(w0.copy()))
+    topt = torch.optim.AdamW([tparam], lr=LR)
+    tsched = torch.optim.lr_scheduler.CosineAnnealingLR(topt, T_max=t_max)
+
+    opt = optim.AdamW(lr=optim.cosine_annealing_lr(LR, t_max=t_max))
+    params = {"w": jnp.asarray(w0)}
+    opt_state = opt.init(params)
+    for i in range(6):
+        g = rng.standard_normal((3, 2)).astype(np.float32)
+        tparam.grad = torch.tensor(g.copy())
+        topt.step()
+        tsched.step()
+        params, opt_state = opt.update({"w": jnp.asarray(g)}, opt_state,
+                                       params)
+        np.testing.assert_allclose(np.asarray(params["w"]),
+                                   tparam.detach().numpy(), atol=2e-6,
+                                   err_msg=f"step {i}")

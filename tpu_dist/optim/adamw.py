@@ -23,18 +23,23 @@ pre-moments, torch.optim.Adam's ``weight_decay`` semantics).
 
 from __future__ import annotations
 
-from typing import Any, Dict
+from typing import Any, Callable, Dict, Union
 
 import jax
 import jax.numpy as jnp
 
 __all__ = ["AdamW", "Adam"]
 
+LrLike = Union[float, Callable[[jnp.ndarray], jnp.ndarray]]
+
 
 class AdamW:
-    def __init__(self, lr: float = 1e-3, betas=(0.9, 0.999),
+    def __init__(self, lr: LrLike = 1e-3, betas=(0.9, 0.999),
                  eps: float = 1e-8, weight_decay: float = 1e-2,
                  decoupled: bool = True):
+        """``lr`` may be a float or a compiled-in schedule
+        (:mod:`tpu_dist.optim.lr_scheduler`): a callable of the update
+        count, evaluated on-device inside the jitted step."""
         if not 0.0 <= betas[0] < 1.0 or not 0.0 <= betas[1] < 1.0:
             raise ValueError(f"Invalid betas {betas}")
         if eps <= 0.0:
@@ -58,6 +63,9 @@ class AdamW:
         c1 = 1.0 - b1 ** t.astype(jnp.float32)
         c2 = 1.0 - b2 ** t.astype(jnp.float32)
         wd = self.weight_decay
+        # callable lr = a compiled-in schedule of the pre-update step count
+        # (tpu_dist.optim.lr_scheduler); first update uses lr(0)
+        lr = self.lr(opt_state["step"]) if callable(self.lr) else self.lr
 
         if wd and not self.decoupled:
             grads = jax.tree.map(lambda g, p: g + wd * p, grads, params)
@@ -70,14 +78,14 @@ class AdamW:
         def step(p, m, v):
             upd = (m / c1) / (jnp.sqrt(v / c2) + self.eps)
             if wd and self.decoupled:
-                p = p - self.lr * wd * p             # AdamW decoupled decay
-            return p - self.lr * upd
+                p = p - lr * wd * p                  # AdamW decoupled decay
+            return p - lr * upd
 
         new_params = jax.tree.map(step, params, new_m, new_v)
         return new_params, {"m": new_m, "v": new_v, "step": t}
 
 
-def Adam(lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8,
+def Adam(lr: LrLike = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8,
          weight_decay: float = 0.0) -> AdamW:
     """torch.optim.Adam semantics: L2 weight decay folded into gradients."""
     return AdamW(lr=lr, betas=betas, eps=eps, weight_decay=weight_decay,

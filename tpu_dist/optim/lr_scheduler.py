@@ -1,0 +1,123 @@
+"""Learning-rate schedules — torch.optim.lr_scheduler parity, compiled in.
+
+torch schedulers are *stateful objects* mutating ``optimizer.param_groups``
+between steps; on TPU that would force a recompile (or a host round-trip)
+every time the lr changes.  Here a schedule is a **pure function of the
+on-device step counter** ``f(step) -> lr`` built from ``jnp`` ops, passed
+*as* the optimizer's ``lr``: the optimizer evaluates it inside the jitted
+train step, so the whole schedule compiles into the XLA graph once and the
+lr changes every step for free.
+
+The reference never schedules (its scripts use fixed lr,
+/root/reference/mpspawn_dist.py:64, example_mp.py:84-90); this exists for
+torch API completeness (torch.optim.lr_scheduler is part of the surface
+its README's training flow implies) and for the LM workloads where
+warmup+decay is the default recipe.
+
+Semantics note: torch schedulers usually ``.step()`` once per *epoch*;
+these are functions of whatever counter the optimizer maintains (one tick
+per ``update``).  To schedule per-epoch, scale boundaries by
+steps-per-epoch.  All match their torch namesakes exactly as sequences:
+``schedule(i) == torch_scheduler_lr_after_i_steps`` (tested).
+
+Usage::
+
+    sched = optim.warmup_cosine(peak_lr=3e-4, warmup_steps=1000,
+                                total_steps=100_000)
+    opt = optim.AdamW(lr=sched)          # optimizers accept callables
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax.numpy as jnp
+
+__all__ = ["step_lr", "multistep_lr", "exponential_lr", "linear_lr",
+           "cosine_annealing_lr", "constant_lr", "warmup_cosine",
+           "sequential_lr"]
+
+Schedule = Callable[[jnp.ndarray], jnp.ndarray]
+
+
+def _f32(step):
+    return jnp.asarray(step).astype(jnp.float32)
+
+
+def step_lr(lr: float, step_size: int, gamma: float = 0.1) -> Schedule:
+    """``torch.optim.lr_scheduler.StepLR``: decay by ``gamma`` every
+    ``step_size`` steps."""
+    return lambda step: lr * gamma ** jnp.floor(_f32(step) / step_size)
+
+
+def multistep_lr(lr: float, milestones: Sequence[int],
+                 gamma: float = 0.1) -> Schedule:
+    """``MultiStepLR``: decay by ``gamma`` at each milestone step."""
+    ms = jnp.asarray(sorted(milestones), jnp.float32)
+    return lambda step: lr * gamma ** jnp.sum(_f32(step) >= ms)
+
+
+def exponential_lr(lr: float, gamma: float) -> Schedule:
+    """``ExponentialLR``: multiply by ``gamma`` every step."""
+    return lambda step: lr * gamma ** _f32(step)
+
+
+def linear_lr(lr: float, start_factor: float = 1.0 / 3,
+              end_factor: float = 1.0, total_iters: int = 5) -> Schedule:
+    """``LinearLR``: interpolate the lr factor from ``start_factor`` to
+    ``end_factor`` over ``total_iters`` steps (constant after)."""
+    def f(step):
+        t = jnp.clip(_f32(step) / total_iters, 0.0, 1.0)
+        return lr * (start_factor + (end_factor - start_factor) * t)
+    return f
+
+
+def cosine_annealing_lr(lr: float, t_max: int,
+                        eta_min: float = 0.0) -> Schedule:
+    """``CosineAnnealingLR``: cosine from ``lr`` to ``eta_min`` over
+    ``t_max`` steps (continues the cosine past t_max, like torch)."""
+    def f(step):
+        return eta_min + 0.5 * (lr - eta_min) * (
+            1.0 + jnp.cos(jnp.pi * _f32(step) / t_max))
+    return f
+
+
+def constant_lr(lr: float, factor: float = 1.0 / 3,
+                total_iters: int = 5) -> Schedule:
+    """``ConstantLR``: ``lr * factor`` for the first ``total_iters`` steps,
+    then ``lr``."""
+    return lambda step: lr * jnp.where(_f32(step) < total_iters, factor, 1.0)
+
+
+def sequential_lr(schedules: Sequence[Schedule],
+                  milestones: Sequence[int]) -> Schedule:
+    """``SequentialLR``: switch between schedules at the milestone steps;
+    each schedule sees a counter restarted at its milestone."""
+    if len(schedules) != len(milestones) + 1:
+        raise ValueError(f"{len(schedules)} schedules need "
+                         f"{len(schedules) - 1} milestones, got "
+                         f"{len(milestones)}")
+    bounds = [0] + list(milestones)
+
+    def f(step):
+        s = _f32(step)
+        out = schedules[0](s)
+        for sched, b in zip(schedules[1:], bounds[1:]):
+            out = jnp.where(s >= b, sched(s - b), out)
+        return out
+    return f
+
+
+def warmup_cosine(peak_lr: float, warmup_steps: int, total_steps: int,
+                  end_lr: float = 0.0) -> Schedule:
+    """Linear warmup 0 → ``peak_lr`` then cosine decay to ``end_lr`` — the
+    standard LM recipe (no single torch class; equals SequentialLR of
+    LinearLR + CosineAnnealingLR)."""
+    def f(step):
+        s = _f32(step)
+        warm = peak_lr * s / jnp.maximum(warmup_steps, 1)
+        t = jnp.clip((s - warmup_steps) / jnp.maximum(
+            total_steps - warmup_steps, 1), 0.0, 1.0)
+        decay = end_lr + 0.5 * (peak_lr - end_lr) * (1.0 + jnp.cos(jnp.pi * t))
+        return jnp.where(s < warmup_steps, warm, decay)
+    return f

@@ -21,18 +21,23 @@ when dampening is 0 (the only configuration the reference uses).
 
 from __future__ import annotations
 
-from typing import Any, Dict
+from typing import Any, Callable, Dict, Union
 
 import jax
 import jax.numpy as jnp
 
 __all__ = ["SGD"]
 
+LrLike = Union[float, Callable[[jnp.ndarray], jnp.ndarray]]
+
 
 class SGD:
-    def __init__(self, lr: float, momentum: float = 0.0,
+    def __init__(self, lr: LrLike, momentum: float = 0.0,
                  weight_decay: float = 0.0, nesterov: bool = False,
                  dampening: float = 0.0):
+        """``lr`` may be a float or a compiled-in schedule
+        (:mod:`tpu_dist.optim.lr_scheduler`): a callable of the update
+        count, evaluated on-device inside the jitted step."""
         if nesterov and (momentum <= 0 or dampening != 0):
             raise ValueError("Nesterov momentum requires momentum > 0 and "
                              "dampening = 0")
@@ -43,14 +48,23 @@ class SGD:
         self.dampening = dampening
 
     def init(self, params) -> Dict[str, Any]:
-        if self.momentum == 0.0:
-            return {}
-        return {"momentum": jax.tree.map(jnp.zeros_like, params)}
+        state: Dict[str, Any] = {}
+        if callable(self.lr):
+            state["step"] = jnp.zeros((), jnp.int32)
+        if self.momentum != 0.0:
+            state["momentum"] = jax.tree.map(jnp.zeros_like, params)
+        return state
 
     def update(self, grads, opt_state, params):
         """Return ``(new_params, new_opt_state)``; pure function of inputs."""
-        lr, mom, wd, damp = (self.lr, self.momentum, self.weight_decay,
-                             self.dampening)
+        mom, wd, damp = self.momentum, self.weight_decay, self.dampening
+        if callable(self.lr):
+            # schedule of the pre-update step count: the first update uses
+            # lr(0), matching a torch scheduler set before optimizer.step()
+            lr = self.lr(opt_state["step"])
+            opt_state = dict(opt_state, step=opt_state["step"] + 1)
+        else:
+            lr = self.lr
 
         if mom == 0.0:
             def step(p, g):
@@ -70,4 +84,4 @@ class SGD:
         else:
             new_params = jax.tree.map(lambda p, buf: p - lr * buf,
                                       params, new_buf)
-        return new_params, {"momentum": new_buf}
+        return new_params, dict(opt_state, momentum=new_buf)

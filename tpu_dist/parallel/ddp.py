@@ -62,6 +62,14 @@ def _ceil_to(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+def _zero1_spec(leaf, axis: str) -> P:
+    """ZeRO-1 opt-state placement rule: rank>=1 leaves shard 1/world over
+    the data axis; scalar leaves (schedule/Adam step counters) replicate.
+    Single source of truth for state_shardings() and the train-step
+    in/out_specs — they must agree or restore-time placement breaks."""
+    return P(axis) if getattr(leaf, "ndim", 0) >= 1 else P()
+
+
 def _flatten_params(tree):
     """Concatenate all leaves, raveled, in tree-flatten order."""
     leaves = jax.tree.leaves(tree)
@@ -191,13 +199,15 @@ class DistributedDataParallel:
         repl = NamedSharding(self.group.mesh, P())
         shardings = jax.tree.map(lambda _: repl, state)
         if self.shard_optimizer and self.optimizer is not None:
-            osh = NamedSharding(self.group.mesh, P(self.axis))
             shardings = shardings._replace(
-                opt_state=jax.tree.map(lambda _: osh, state.opt_state))
+                opt_state=jax.tree.map(
+                    lambda l: NamedSharding(self.group.mesh,
+                                            _zero1_spec(l, self.axis)),
+                    state.opt_state))
         return shardings
 
     # -- compiled steps --------------------------------------------------------
-    def _build_train_step(self):
+    def _build_train_step(self, template: TrainState):
         module, loss_fn, optimizer, axis = (self.module, self.loss_fn,
                                             self.optimizer, self.axis)
         has_state = module.has_state()
@@ -312,9 +322,13 @@ class DistributedDataParallel:
             return new_state, {"loss": loss, "correct": correct}
 
         mesh = self.group.mesh
+        if zero1:
+            opt_spec = jax.tree.map(lambda l: _zero1_spec(l, axis),
+                                    template.opt_state)
+        else:
+            opt_spec = P()
         state_spec = TrainState(params=P(), model_state=P(),
-                                opt_state=P(axis) if zero1 else P(),
-                                step=P(), rng=P())
+                                opt_state=opt_spec, step=P(), rng=P())
         fn = jax.shard_map(local_step, mesh=mesh,
                            in_specs=(state_spec, P(axis), P(axis)),
                            out_specs=(state_spec, P()))
@@ -346,7 +360,7 @@ class DistributedDataParallel:
         if self.optimizer is None or self.loss_fn is None:
             raise ValueError("train_step requires optimizer= and loss_fn=")
         if self._train_step is None:
-            self._train_step = self._build_train_step()
+            self._train_step = self._build_train_step(state)
         return self._train_step(state, x, y)
 
     def eval_step(self, state: TrainState, x, y):
