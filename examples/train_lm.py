@@ -15,7 +15,20 @@ script, three parallelism modes over the same model:
                   n_seq times longer than one core can hold.
   --parallel tp   GSPMD Megatron-style tensor parallelism on a
                   (data × model) mesh: QKV/MLP column+row sharded via
-                  TRANSFORMer_TP_RULES; XLA inserts the all-reduces.
+                  TRANSFORMER_TP_RULES; XLA inserts the all-reduces.
+  --parallel pp   GPipe pipeline parallelism on a (data × pipe) mesh:
+                  trunk blocks stacked + sharded over 'pipe' (optimizer
+                  state sharded with them), microbatches flow stage to
+                  stage over ICI ppermute hops inside one lax.scan.
+  --parallel ep   Mixture-of-Experts expert parallelism on a (data ×
+                  expert) mesh: every block's MLP becomes a top-2-routed
+                  MoELayer, expert FFN weights sharded over 'expert'
+                  (MOE_EP_RULES), token all-to-alls inserted by XLA,
+                  Switch load-balance aux loss in the objective.
+
+--lr-schedule warmup_cosine compiles a warmup+cosine decay schedule into
+the jitted step (tpu_dist.optim.lr_scheduler) — the lr changes every step
+with no recompile.
 
 Synthetic task: next token = a fixed random permutation of the current
 token — exactly learnable, so falling loss (printed rank-0 style, the
@@ -46,7 +59,8 @@ def make_batches(rng, perm, vocab, batch, seq_len, steps):
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--parallel", default="dp", choices=["dp", "sp", "tp"])
+    p.add_argument("--parallel", default="dp",
+                   choices=["dp", "sp", "tp", "pp", "ep"])
     p.add_argument("--sp-mode", default="ring", choices=["ring", "ulysses"])
     p.add_argument("--backend", default="tpu", choices=["tpu", "cpu"])
     p.add_argument("--steps", default=200, type=int)
@@ -59,6 +73,14 @@ def main():
     p.add_argument("--heads", default=8, type=int)
     p.add_argument("--vocab", default=256, type=int)
     p.add_argument("--lr", default=0.5, type=float)
+    p.add_argument("--lr-schedule", default="none",
+                   choices=["none", "warmup_cosine"],
+                   help="compiled-in schedule (peak = --lr, 10%% warmup)")
+    p.add_argument("--microbatches", default=0, type=int,
+                   help="pp only: microbatch count (0 = one per stage)")
+    p.add_argument("--experts", default=4, type=int,
+                   help="ep only: expert count (rounded up to a multiple "
+                        "of the 'expert' axis size)")
     p.add_argument("--log-every", default=20, type=int)
     args = p.parse_args()
 
@@ -85,6 +107,13 @@ def main():
     perm = rng.permutation(args.vocab)
     start = datetime.now()
 
+    def make_lr():
+        if args.lr_schedule == "warmup_cosine":
+            return optim.warmup_cosine(peak_lr=args.lr,
+                                       warmup_steps=max(args.steps // 10, 1),
+                                       total_steps=args.steps)
+        return args.lr
+
     if args.parallel == "dp":
         dist.init_process_group(backend=args.backend)
         pg = dist.get_default_group()
@@ -94,7 +123,7 @@ def main():
         model = TransformerLM(args.vocab, dim=args.dim, depth=args.depth,
                               num_heads=args.heads, max_seq_len=args.seq_len)
         ddp = DistributedDataParallel(
-            model, optimizer=optim.SGD(lr=args.lr),
+            model, optimizer=optim.SGD(lr=make_lr()),
             loss_fn=nn.CrossEntropyLoss(), group=pg)
         state = ddp.init(seed=0)
         shard = NamedSharding(pg.mesh, P(pg.axis_name))
@@ -122,7 +151,7 @@ def main():
                               num_heads=args.heads, max_seq_len=seq_len,
                               sequence_axis="seq", mode=args.sp_mode)
         params = model.init(jax.random.key(0))
-        opt = optim.SGD(lr=args.lr)
+        opt = optim.SGD(lr=make_lr())
         opt_state = opt.init(params)
         ce = nn.CrossEntropyLoss()
 
@@ -153,6 +182,79 @@ def main():
                       f"loss: {float(loss):.4f}  "
                       f"(seq {seq_len} over {sp} cores, {args.sp_mode})")
 
+    elif args.parallel == "pp":
+        n = len(jax.devices())
+        dp = 2 if n % 2 == 0 and n > 1 else 1
+        pipe = n // dp
+        dist.init_process_group(backend=args.backend,
+                                axis_names=("data", "pipe"),
+                                mesh_shape=(dp, pipe))
+        pg = dist.get_default_group()
+        from tpu_dist.parallel import PipelineParallel
+
+        depth = max(args.depth // pipe, 1) * pipe      # divisible stages
+        model = TransformerLM(args.vocab, dim=args.dim, depth=depth,
+                              num_heads=args.heads, max_seq_len=args.seq_len)
+        pp_wrap = PipelineParallel(
+            model, optimizer=optim.SGD(lr=make_lr()),
+            loss_fn=nn.CrossEntropyLoss(),
+            num_microbatches=args.microbatches or None)
+        state = pp_wrap.init(seed=0)
+        m_count = pp_wrap.num_microbatches
+        batch = max(args.batch_size // (dp * m_count), 1) * dp * m_count
+        bsh = NamedSharding(pg.mesh, P("data"))
+        for i, (x, y) in enumerate(make_batches(rng, perm, args.vocab,
+                                                batch, args.seq_len,
+                                                args.steps)):
+            state, metrics = pp_wrap.train_step(
+                state, jax.device_put(x, bsh), jax.device_put(y, bsh))
+            if dist.get_rank() == 0 and (i + 1) % args.log_every == 0:
+                print(f"Step [{i + 1}/{args.steps}] "
+                      f"loss: {float(metrics['loss']):.4f}  "
+                      f"({pipe} stages x {m_count} microbatches)")
+
+    elif args.parallel == "ep":
+        n = len(jax.devices())
+        dp = 2 if n % 2 == 0 and n > 1 else 1
+        ep = n // dp
+        dist.init_process_group(backend=args.backend,
+                                axis_names=("data", "expert"),
+                                mesh_shape=(dp, ep))
+        pg = dist.get_default_group()
+        from tpu_dist.parallel import (MOE_EP_RULES, make_gspmd_train_step,
+                                       shard_pytree)
+
+        # round UP to a multiple of the expert-axis size: the stacked expert
+        # weights' leading dim must split evenly over P('expert')
+        experts = -(-max(args.experts, 2) // ep) * ep
+        model = TransformerLM(args.vocab, dim=args.dim, depth=args.depth,
+                              num_heads=args.heads, max_seq_len=args.seq_len,
+                              num_experts=experts)
+        ce = nn.CrossEntropyLoss()
+        opt = optim.SGD(lr=make_lr())
+        params = shard_pytree(model.init(jax.random.key(0)), pg.mesh,
+                              MOE_EP_RULES)
+        mstate = shard_pytree(model.init_state(), pg.mesh)
+        opt_state = opt.init(params)
+        step = make_gspmd_train_step(
+            model, lambda lg, y: ce(lg.reshape(-1, args.vocab),
+                                    y.reshape(-1)), opt,
+            aux_loss_coeff=0.01)
+        batch = max(args.batch_size // dp, 1) * dp
+        bsh = NamedSharding(pg.mesh, P("data", None))
+        for i, (x, y) in enumerate(make_batches(rng, perm, args.vocab,
+                                                batch, args.seq_len,
+                                                args.steps)):
+            params, opt_state, mstate, m = step(params, opt_state, mstate,
+                                                jax.device_put(x, bsh),
+                                                jax.device_put(y, bsh))
+            if dist.get_rank() == 0 and (i + 1) % args.log_every == 0:
+                aux = sum(float(v["aux_loss"]) for v in mstate.values()
+                          if "aux_loss" in v)
+                print(f"Step [{i + 1}/{args.steps}] "
+                      f"loss: {float(m['loss']):.4f}  "
+                      f"(E={experts} over {ep} cores, aux {aux:.3f})")
+
     else:  # tp
         n = len(jax.devices())
         dp = 2 if n % 2 == 0 and n > 1 else 1
@@ -168,7 +270,7 @@ def main():
         model = TransformerLM(args.vocab, dim=args.dim, depth=args.depth,
                               num_heads=heads, max_seq_len=args.seq_len)
         ce = nn.CrossEntropyLoss()
-        opt = optim.SGD(lr=args.lr)
+        opt = optim.SGD(lr=make_lr())
         params = shard_pytree(model.init(jax.random.key(0)), pg.mesh,
                               TRANSFORMER_TP_RULES)
         opt_state = opt.init(params)
