@@ -132,3 +132,58 @@ class TestLaunchCLI:
             cwd="/root/repo", capture_output=True, text=True, timeout=60)
         assert r.returncode == 0, r.stderr
         assert (tmp_path / "0.json").exists()
+
+
+class TestElasticRestart:
+    """--max_restarts: torchrun-style single-node elastic relaunch."""
+
+    def test_restart_recovers(self, tmp_path):
+        """Round 0 crashes, round 1 (TPU_DIST_RESTART_COUNT=1) succeeds."""
+        script = tmp_path / "flaky.py"
+        script.write_text(textwrap.dedent(f"""
+            import os, sys
+            marker = os.path.join({str(tmp_path)!r},
+                                  "round%s" % os.environ["TPU_DIST_RESTART_COUNT"]
+                                  + "_rank%s" % os.environ["RANK"])
+            open(marker, "w").close()
+            if os.environ["TPU_DIST_RESTART_COUNT"] == "0":
+                sys.exit(7)   # every first-round worker fails
+        """))
+        rc = main(["--nproc_per_node=2", "--max_restarts=1", "--no_store",
+                   str(script)])
+        assert rc == 0
+        assert (tmp_path / "round0_rank0").exists()
+        assert (tmp_path / "round1_rank0").exists()
+        assert (tmp_path / "round1_rank1").exists()
+
+    def test_worker_rc130_is_restarted(self, tmp_path):
+        """A WORKER exiting 130 is a normal failure (restartable); only a
+        launcher-level Ctrl-C skips the restart budget."""
+        script = tmp_path / "sigint_like.py"
+        script.write_text(textwrap.dedent("""
+            import os, sys
+            sys.exit(130 if os.environ["TPU_DIST_RESTART_COUNT"] == "0"
+                     else 0)
+        """))
+        rc = main(["--nproc_per_node=1", "--max_restarts=1", "--no_store",
+                   str(script)])
+        assert rc == 0
+
+    def test_restarts_exhausted(self, tmp_path):
+        script = tmp_path / "alwaysfail.py"
+        script.write_text("import sys; sys.exit(9)\n")
+        rc = main(["--nproc_per_node=1", "--max_restarts=2", "--no_store",
+                   str(script)])
+        assert rc == 9
+
+    def test_zero_restarts_is_fail_fast(self, tmp_path):
+        script = tmp_path / "fail.py"
+        script.write_text("import sys; sys.exit(5)\n")
+        assert main(["--nproc_per_node=1", "--no_store", str(script)]) == 5
+
+    def test_multi_node_rejected(self):
+        assert main(["--nnodes=2", "--node_rank=0", "--max_restarts=1",
+                     "x.py"]) == 2
+
+    def test_negative_rejected(self):
+        assert main(["--max_restarts=-1", "x.py"]) == 2

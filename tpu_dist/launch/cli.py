@@ -78,6 +78,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--pass_local_rank", action="store_true",
                    help="append --local_rank=<n> to the script args "
                         "(classic torch.distributed.launch argv contract)")
+    p.add_argument("--max_restarts", type=int, default=0,
+                   help="relaunch the whole (single-node) world up to N "
+                        "times after a worker failure (torchrun elastic "
+                        "parity); children see TPU_DIST_RESTART_COUNT and "
+                        "should resume from their latest checkpoint")
     p.add_argument("--module", "-m", action="store_true",
                    help="treat script as a python module (python -m ...)")
     p.add_argument("script", type=str)
@@ -162,18 +167,11 @@ def _check_liveness(store, world_size: int) -> List[int]:
         return []
 
 
-def main(argv: Optional[List[str]] = None) -> int:
-    args = build_parser().parse_args(argv)
-    if args.node_rank >= args.nnodes or args.node_rank < 0:
-        sys.stderr.write(f"--node_rank {args.node_rank} out of range for "
-                         f"--nnodes {args.nnodes}\n")
-        return 2
-    world_size = args.nproc_per_node * args.nnodes
-
-    store, master_port, store_addr = _setup_store(args)
-    if master_port is None:
-        return 2
-
+def _spawn_world(args, world_size: int, master_port: int,
+                 store_addr: Optional[str],
+                 restart_count: int) -> List[subprocess.Popen]:
+    """Spawn this node's ranks; on partial failure kill the already-spawned
+    ranks (never leave them orphaned in the rendezvous wait) and re-raise."""
     procs: List[subprocess.Popen] = []
     try:
         for local_rank in range(args.nproc_per_node):
@@ -185,7 +183,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                        LOCAL_WORLD_SIZE=str(args.nproc_per_node),
                        NODE_RANK=str(args.node_rank),
                        MASTER_ADDR=args.master_addr,
-                       MASTER_PORT=str(master_port))
+                       MASTER_PORT=str(master_port),
+                       TPU_DIST_RESTART_COUNT=str(restart_count))
             if store_addr is not None:
                 env["TPU_DIST_STORE_ADDR"] = store_addr
             cmd = [sys.executable]
@@ -197,28 +196,33 @@ def main(argv: Optional[List[str]] = None) -> int:
             if args.pass_local_rank:
                 cmd += [f"--local_rank={local_rank}"]
             procs.append(subprocess.Popen(cmd, env=env))
-    except Exception:
-        # partial world: never leave already-spawned ranks orphaned in the
-        # rendezvous wait
+    except BaseException:
+        # includes KeyboardInterrupt mid-loop: already-spawned children
+        # would otherwise sit in the rendezvous pre-flight wait for minutes
         for p in procs:
             if p.poll() is None:
                 p.kill()
                 p.wait()
-        if store is not None:
-            try:
-                store.close()
-            except Exception:
-                pass
         raise
+    return procs
 
-    # Fail fast: first non-zero exit kills the rest (mp.spawn-style semantics
-    # the reference depends on; torch.distributed.launch exits similarly).
-    # TERM then KILL: jax.distributed installs a SIGTERM handler (preemption
-    # notifier), so a child in rendezvous/teardown survives terminate() and
-    # would otherwise linger until the coordination-service heartbeat
-    # timeout (~100s); escalate to SIGKILL after a grace period.
+
+def _watch_world(args, procs: List[subprocess.Popen], store,
+                 world_size: int):
+    """Monitor one round until every rank exits → ``(exit_code,
+    interrupted)``; ``interrupted`` distinguishes launcher Ctrl-C (never
+    restarted) from a worker that happened to exit with code 130.
+
+    Fail fast: first non-zero exit kills the rest (mp.spawn-style semantics
+    the reference depends on; torch.distributed.launch exits similarly).
+    TERM then KILL: jax.distributed installs a SIGTERM handler (preemption
+    notifier), so a child in rendezvous/teardown survives terminate() and
+    would otherwise linger until the coordination-service heartbeat
+    timeout (~100s); escalate to SIGKILL after a grace period.
+    """
     kill_grace = 15.0
     exit_code = 0
+    interrupted = False
     t0 = time.monotonic()
     kill_deadline = None
     liveness_reported = world_size <= 1 or store is None or args.node_rank != 0
@@ -268,10 +272,72 @@ def main(argv: Optional[List[str]] = None) -> int:
                 p.kill()
                 p.wait()
         exit_code = 130
+        interrupted = True
+    return exit_code, interrupted
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.node_rank >= args.nnodes or args.node_rank < 0:
+        sys.stderr.write(f"--node_rank {args.node_rank} out of range for "
+                         f"--nnodes {args.nnodes}\n")
+        return 2
+    if args.max_restarts < 0:
+        sys.stderr.write(f"--max_restarts must be >= 0\n")
+        return 2
+    if args.max_restarts > 0 and args.nnodes > 1:
+        # multi-node elastic needs a cross-launcher rendezvous-round
+        # protocol (every node must agree to restart together); the
+        # single-node world is relaunched whole, which needs no agreement
+        sys.stderr.write("--max_restarts requires --nnodes=1 (single-node "
+                         "elastic); multi-node restart coordination is not "
+                         "implemented\n")
+        return 2
+    world_size = args.nproc_per_node * args.nnodes
+
+    store, master_port, store_addr = _setup_store(args)
+    if master_port is None:
+        return 2
+    negotiated_port = args.master_port == 0
+
+    restarts = 0
+    try:
+        while True:
+            procs = _spawn_world(args, world_size, master_port, store_addr,
+                                 restarts)
+            exit_code, interrupted = _watch_world(args, procs, store,
+                                                  world_size)
+            if exit_code == 0 or interrupted \
+                    or restarts >= args.max_restarts:
+                return exit_code
+            restarts += 1
+            sys.stderr.write(
+                f"[tpu_dist.launch] worker failed (rc={exit_code}); "
+                f"restart {restarts}/{args.max_restarts} — relaunching "
+                f"the world\n")
+            if store is not None:
+                # reset last round's control-plane state: liveness marks
+                # AND the teardown-barrier arrival counter — a partial
+                # teardown (one rank crashed mid-round) leaves the counter
+                # off-generation, which would make the next round's first
+                # teardown caller sail through the barrier early
+                for r in range(world_size):
+                    try:
+                        store.delete_key(f"tpu_dist/alive/{r}")
+                    except Exception:
+                        pass
+                try:
+                    store.delete_key("__barrier__/teardown")
+                except Exception:
+                    pass
+            if negotiated_port:
+                # the old coordinator socket may still be in TIME_WAIT;
+                # restarts are single-node only, so the children get the
+                # fresh port via env — no store re-publication needed
+                master_port = _free_port()
     finally:
         if store is not None:
             try:
                 store.close()
             except Exception:
                 pass
-    return exit_code
