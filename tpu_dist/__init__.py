@@ -4,15 +4,20 @@ Provides the machinery the reference tutorial
 (Jackxiini/Pytorch-distributed-learning) obtains from PyTorch, redesigned for
 TPU.  Currently shipped subpackages:
 
-- ``tpu_dist.nn`` — functional module system + XLA-lowered layers/losses
-- ``tpu_dist.optim`` — pure-pytree optimizers (SGD w/ momentum/nesterov/wd)
-- ``tpu_dist.models`` — reference workloads (MNIST ConvNet, ResNet-18/34/50)
+- ``tpu_dist.nn`` — functional module system + XLA-lowered layers/losses,
+  attention (dense/flash), MoELayer
+- ``tpu_dist.optim`` — pure-pytree optimizers (SGD, AdamW/Adam), grad
+  clipping, compiled-in lr schedules
+- ``tpu_dist.models`` — MNIST ConvNet, ResNet-18/34/50, TransformerLM
+  (optionally MoE)
 - ``tpu_dist.dist`` — process groups, rendezvous, TCP/File stores (c10d)
 - ``tpu_dist.collectives`` — in-jit (psum/ring) + eager collectives
 - ``tpu_dist.data`` — samplers, datasets, transforms, device prefetch
-- ``tpu_dist.parallel`` — DistributedDataParallel (fused-psum train step)
-- ``tpu_dist.checkpoint`` — atomic step-numbered save/restore
+- ``tpu_dist.parallel`` — DDP, GSPMD tensor parallel, GPipe pipeline,
+  ring/Ulysses sequence parallel, MoE expert-parallel rules
+- ``tpu_dist.checkpoint`` — atomic step-numbered save/restore (sharded ok)
 - ``tpu_dist.utils`` — rank-0 logging, metric windows, profiling
+- ``tpu_dist.ops`` — Pallas TPU kernels (fused CE, flash attention)
 """
 
 __version__ = "0.1.0"
