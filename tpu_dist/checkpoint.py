@@ -41,7 +41,8 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
-__all__ = ["save", "restore", "latest_step", "all_steps"]
+__all__ = ["save", "restore", "latest_step", "all_steps",
+           "AsyncCheckpointer"]
 
 _STEP_DIR = re.compile(r"^step_(\d{8})$")
 
@@ -69,6 +70,17 @@ def _materialize(leaf) -> np.ndarray:
     return np.asarray(leaf)
 
 
+def _participate_in_gather(tree) -> None:
+    """Non-zero processes' half of the save collective: join the allgather
+    of every non-fully-addressable leaf, write nothing.  Must mirror the
+    leaf order of the writing process (both iterate ``_flatten``)."""
+    import jax
+
+    for leaf in _flatten(tree).values():
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            _materialize(leaf)
+
+
 def save(root: str, tree: Any, step: int, metadata: Optional[Dict] = None,
          keep: Optional[int] = None) -> str:
     """Write checkpoint ``root/step_{step:08d}``; returns its path.
@@ -84,15 +96,20 @@ def save(root: str, tree: Any, step: int, metadata: Optional[Dict] = None,
 
     path = os.path.join(root, f"step_{step:08d}")
     if jax.process_index() != 0:
-        # participate in the collective gather of non-addressable leaves,
-        # write nothing
-        for leaf in _flatten(tree).values():
-            if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
-                _materialize(leaf)
+        _participate_in_gather(tree)
         return path
     # materialize (the collective part) BEFORE any fallible filesystem op:
     # a proc-0 I/O error must raise, not strand peers inside the allgather
     arrays = {k: _materialize(v) for k, v in _flatten(tree).items()}
+    _write(root, path, arrays, step, metadata, keep)
+    return path
+
+
+def _write(root: str, path: str, arrays: Dict[str, np.ndarray], step: int,
+           metadata: Optional[Dict], keep: Optional[int]) -> None:
+    """Serialize already-host-side arrays to ``path`` (atomic tmp+rename),
+    then prune to the newest ``keep`` step dirs.  Pure host I/O — safe to
+    run off-thread (the AsyncCheckpointer's worker)."""
     os.makedirs(root, exist_ok=True)
     tmp = tempfile.mkdtemp(dir=root, prefix=".tmp_ckpt_")
     try:
@@ -116,7 +133,99 @@ def save(root: str, tree: Any, step: int, metadata: Optional[Dict] = None,
         for s in all_steps(root)[:-keep]:
             shutil.rmtree(os.path.join(root, f"step_{s:08d}"),
                           ignore_errors=True)
-    return path
+
+
+class AsyncCheckpointer:
+    """Background checkpoint writer — the step loop never blocks on disk.
+
+    ``save()`` splits the work at the only boundary that matters on TPU:
+    the device→host transfer (which must see the live arrays, and is the
+    collective part under multi-host shardings) runs synchronously in the
+    caller, then serialization + atomic rename + pruning run on a single
+    worker thread.  The train loop reclaims the save latency that matters
+    (disk I/O); the host copy it still pays is the same one the optimizer
+    barrier already forces.
+
+    One write in flight at a time: a new ``save`` first joins the previous
+    one (bounded memory — at most two host copies of the state alive), and
+    any worker exception re-raises there, in ``wait()``, or in ``close()``.
+    Use as a context manager to guarantee the last write lands::
+
+        with AsyncCheckpointer(root, keep=3) as ckpt:
+            for step in range(n):
+                state, _ = ddp.train_step(state, x, y)
+                if step % 100 == 0:
+                    ckpt.save(jax.device_get(state), step=step)
+
+    torch parity note: torch.save has no async form; this plays the role
+    orbax's AsyncCheckpointer plays in the JAX ecosystem, over the same
+    self-contained directory format as :func:`save` (restore with
+    :func:`restore`, fully interchangeable).
+    """
+
+    def __init__(self, root: str, keep: Optional[int] = None):
+        from concurrent.futures import ThreadPoolExecutor
+        self.root = root
+        self.keep = keep
+        self._pool = ThreadPoolExecutor(max_workers=1,
+                                        thread_name_prefix="tpu_dist-ckpt")
+        self._inflight = None
+
+    def save(self, tree: Any, step: int,
+             metadata: Optional[Dict] = None) -> str:
+        """Queue ``root/step_{step:08d}``; returns its (future) path.
+
+        Blocks only for (a) the previous write, if still running, and
+        (b) the device→host materialization of ``tree``.  Under multi-host
+        shardings every process must call this (the gather is collective);
+        non-zero processes return without queuing I/O, like :func:`save`.
+        """
+        import jax
+
+        path = os.path.join(self.root, f"step_{step:08d}")
+        if self._pool is None:
+            raise RuntimeError("AsyncCheckpointer is closed")
+        self.wait()  # one in-flight write; surfaces previous write errors
+        if jax.process_index() != 0:
+            _participate_in_gather(tree)
+            return path
+
+        def snapshot(v):
+            a = _materialize(v)
+            # the async write must OWN its data: np.asarray is a no-copy
+            # view both for host numpy leaves (caller may mutate after
+            # save() returns) and for CPU-backend jax Arrays (the next
+            # donated train step overwrites the buffer in place while the
+            # worker is still serializing it)
+            if a is v or not a.flags.owndata:
+                a = a.copy()
+            return a
+
+        arrays = {k: snapshot(v) for k, v in _flatten(tree).items()}
+        self._inflight = self._pool.submit(
+            _write, self.root, path, arrays, step, metadata, self.keep)
+        return path
+
+    def wait(self) -> None:
+        """Join the in-flight write; re-raises its exception if it failed."""
+        if self._inflight is not None:
+            fut, self._inflight = self._inflight, None
+            fut.result()
+
+    def close(self) -> None:
+        """Finish the in-flight write and shut the worker down."""
+        if self._pool is not None:
+            try:
+                self.wait()
+            finally:
+                self._pool.shutdown(wait=True)
+                self._pool = None
+
+    def __enter__(self) -> "AsyncCheckpointer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 def all_steps(root: str):
