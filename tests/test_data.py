@@ -259,3 +259,174 @@ class TestDeviceLoader:
                 np.testing.assert_array_equal(py, np.asarray(dy))
         finally:
             dist.destroy_process_group()
+
+
+class TestDatasetComposition:
+    """Subset / ConcatDataset / random_split (torch.utils.data parity)."""
+
+    def _ds(self, n=10, base=0):
+        x = np.arange(n * 4, dtype=np.float32).reshape(n, 4) + base
+        y = np.arange(n, dtype=np.int64) + base
+        return ArrayImageDataset(x, y)
+
+    def test_subset_indexing_and_gather(self):
+        from tpu_dist.data import Subset
+        ds = self._ds(10)
+        sub = Subset(ds, [7, 2, 5])
+        assert len(sub) == 3
+        np.testing.assert_array_equal(sub[1][0], ds[2][0])
+        gx, gy = sub.gather(np.array([0, 2]))
+        np.testing.assert_array_equal(gy, [7, 5])
+
+    def test_concat_order_and_gather(self):
+        from tpu_dist.data import ConcatDataset
+        a, b = self._ds(4, base=0), self._ds(3, base=100)
+        cat = ConcatDataset([a, b])
+        assert len(cat) == 7
+        np.testing.assert_array_equal(cat[4][0], b[0][0])
+        # gather crossing the boundary, out of order
+        gx, gy = cat.gather(np.array([5, 1, 4, 0]))
+        np.testing.assert_array_equal(gy, [101, 1, 100, 0])
+
+    def test_concat_negative_and_range(self):
+        from tpu_dist.data import ConcatDataset
+        cat = ConcatDataset([self._ds(2), self._ds(2, base=50)])
+        np.testing.assert_array_equal(cat[-1][0], cat[3][0])
+        with pytest.raises(IndexError):
+            cat[4]
+
+    def test_random_split_partition(self):
+        from tpu_dist.data import random_split
+        ds = self._ds(10)
+        a, b = random_split(ds, [7, 3], seed=1)
+        assert len(a) == 7 and len(b) == 3
+        seen = sorted(int(a.indices[i]) for i in range(7)) + \
+               sorted(int(b.indices[i]) for i in range(3))
+        assert sorted(seen) == list(range(10))
+        # same seed -> same split on every "process"
+        a2, _ = random_split(ds, [7, 3], seed=1)
+        np.testing.assert_array_equal(a.indices, a2.indices)
+
+    def test_random_split_fractions(self):
+        from tpu_dist.data import random_split
+        parts = random_split(self._ds(10), [0.5, 0.25, 0.25], seed=0)
+        # floors [5,2,2], remainder round-robins from the first (torch rule)
+        import torch.utils.data as tud
+        tparts = tud.random_split(range(10), [0.5, 0.25, 0.25])
+        assert [len(p) for p in parts] == [len(t) for t in tparts] == [6, 2, 2]
+
+    def test_random_split_bad_lengths(self):
+        from tpu_dist.data import random_split
+        with pytest.raises(ValueError, match="sum of lengths"):
+            random_split(self._ds(10), [4, 4])
+
+    def test_subset_in_loader(self):
+        from tpu_dist.data import DataLoader, Subset
+        ds = self._ds(8)
+        loader = DataLoader(Subset(ds, [6, 4, 2, 0]), batch_size=2)
+        batches = list(loader)
+        assert len(batches) == 2
+        np.testing.assert_array_equal(batches[0][1], [6, 4])
+
+
+class TestExtraSamplers:
+    def test_weighted_zero_weight_never_sampled(self):
+        from tpu_dist.data import WeightedRandomSampler
+        w = [1.0, 0.0, 1.0, 5.0]
+        s = WeightedRandomSampler(w, num_samples=200, seed=3)
+        idx = list(s)
+        assert len(idx) == 200 and 1 not in idx
+        # heavier weight drawn more often
+        assert idx.count(3) > idx.count(0)
+
+    def test_weighted_without_replacement_distinct(self):
+        from tpu_dist.data import WeightedRandomSampler
+        s = WeightedRandomSampler([1, 2, 3, 4], num_samples=4,
+                                  replacement=False)
+        idx = list(s)
+        assert sorted(idx) == [0, 1, 2, 3]
+        with pytest.raises(ValueError, match="without"):
+            WeightedRandomSampler([1, 2], num_samples=3, replacement=False)
+
+    def test_weighted_epoch_determinism(self):
+        from tpu_dist.data import WeightedRandomSampler
+        s = WeightedRandomSampler([1, 1, 1], num_samples=30, seed=0)
+        e0 = list(s)
+        assert list(s) == e0            # same epoch -> same draw
+        s.set_epoch(1)
+        assert list(s) != e0            # reshuffled
+
+    def test_weighted_validation(self):
+        from tpu_dist.data import WeightedRandomSampler
+        with pytest.raises(ValueError, match="non-negative"):
+            WeightedRandomSampler([1.0, -1.0], num_samples=2)
+        with pytest.raises(ValueError, match="num_samples"):
+            WeightedRandomSampler([1.0], num_samples=0)
+
+    def test_subset_random_sampler(self):
+        from tpu_dist.data import SubsetRandomSampler
+        s = SubsetRandomSampler([3, 1, 4, 1, 5])
+        assert len(s) == 5
+        assert sorted(list(s)) == [1, 1, 3, 4, 5]
+        e0 = list(s)
+        s.set_epoch(2)
+        assert sorted(list(s)) == sorted(e0)
+
+
+class TestCompositionLoaderIntegration:
+    """Review-driven regressions: gather fallback, transform forwarding."""
+
+    def test_subset_of_gatherless_dataset_in_loader(self):
+        from tpu_dist.data import DataLoader, Subset, TensorDataset
+        ds = TensorDataset(np.arange(12.0).reshape(6, 2),
+                           np.arange(6))
+        loader = DataLoader(Subset(ds, [4, 2, 0]), batch_size=3)
+        (x, y), = list(loader)   # collate fallback, no crash
+        np.testing.assert_array_equal(y, [4, 2, 0])
+
+    def test_subset_forwards_transform(self):
+        from tpu_dist.data import ArrayImageDataset, DataLoader, Subset
+
+        calls = []
+
+        class Neg:
+            def __call__(self, x, rng=None):
+                calls.append(len(x))
+                return -x
+
+        ds = ArrayImageDataset(np.ones((6, 2, 2, 1), np.float32),
+                               np.arange(6), transform=Neg())
+        loader = DataLoader(Subset(ds, [0, 1, 2, 3]), batch_size=4)
+        (x, _), = list(loader)
+        assert calls == [4]           # augmentation ran, once, on the batch
+        np.testing.assert_array_equal(x, -np.ones((4, 2, 2, 1)))
+
+    def test_concat_rejects_differing_transforms(self):
+        from tpu_dist.data import ArrayImageDataset, ConcatDataset
+        mk = lambda t: ArrayImageDataset(np.ones((2, 2, 2, 1), np.float32),
+                                         np.arange(2), transform=t)
+        with pytest.raises(ValueError, match="differing transforms"):
+            ConcatDataset([mk(lambda x, rng=None: x),
+                           mk(lambda x, rng=None: x)])
+        shared = lambda x, rng=None: x
+        cat = ConcatDataset([mk(shared), mk(shared)])  # shared object: ok
+        assert cat.transform is shared
+
+    def test_concat_gather_negative_indices(self):
+        from tpu_dist.data import ConcatDataset
+        a = ArrayImageDataset(np.zeros((2, 1), np.float32), np.array([0, 1]))
+        b = ArrayImageDataset(np.zeros((2, 1), np.float32),
+                              np.array([10, 11]))
+        cat = ConcatDataset([a, b])
+        _, y = cat.gather(np.array([-1, -4]))
+        np.testing.assert_array_equal(y, [11, 0])
+        with pytest.raises(IndexError):
+            cat.gather(np.array([4]))
+
+    def test_weighted_all_zero_rejected(self):
+        from tpu_dist.data import WeightedRandomSampler
+        with pytest.raises(ValueError, match="all be zero"):
+            WeightedRandomSampler([0.0, 0.0], num_samples=2)
+        with pytest.raises(ValueError, match="positive weights"):
+            WeightedRandomSampler([1.0, 0.0], num_samples=2,
+                                  replacement=False)

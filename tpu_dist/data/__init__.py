@@ -7,19 +7,22 @@ transforms, and prefetching device placement.  Replaces
 """
 
 from . import transforms
-from .datasets import (ArrayImageDataset, CIFAR10, Dataset, ImageFolder,
-                       MNIST, SyntheticImageNet, TensorDataset,
+from .datasets import (ArrayImageDataset, CIFAR10, ConcatDataset, Dataset,
+                       ImageFolder, MNIST, Subset, SyntheticImageNet,
+                       TensorDataset, random_split,
                        synthetic_cifar10_arrays, synthetic_mnist_arrays)
 from .loader import DataLoader, DeviceLoader, default_collate
 from .sampler import (BatchSampler, DistributedSampler, RandomSampler,
-                      Sampler, SequentialSampler)
+                      Sampler, SequentialSampler, SubsetRandomSampler,
+                      WeightedRandomSampler)
 
 __all__ = [
     "transforms",
     "Dataset", "TensorDataset", "ArrayImageDataset", "MNIST", "CIFAR10",
     "ImageFolder", "SyntheticImageNet",
+    "Subset", "ConcatDataset", "random_split",
     "synthetic_mnist_arrays", "synthetic_cifar10_arrays",
     "DataLoader", "DeviceLoader", "default_collate",
     "Sampler", "SequentialSampler", "RandomSampler", "BatchSampler",
-    "DistributedSampler",
+    "DistributedSampler", "WeightedRandomSampler", "SubsetRandomSampler",
 ]

@@ -31,6 +31,7 @@ import numpy as np
 __all__ = [
     "Dataset", "TensorDataset", "ArrayImageDataset", "MNIST", "CIFAR10",
     "ImageFolder", "SyntheticImageNet",
+    "Subset", "ConcatDataset", "random_split",
     "synthetic_mnist_arrays", "synthetic_cifar10_arrays",
 ]
 
@@ -86,6 +87,121 @@ class ArrayImageDataset(Dataset):
 
     def gather(self, indices: np.ndarray):
         return self.data[indices], self.targets[indices]
+
+
+class Subset(Dataset):
+    """View of ``dataset`` at ``indices`` (torch ``Subset`` parity).
+
+    Keeps the base's vectorized ``gather`` fast path when it has one
+    (indices compose by fancy indexing, no Python loop), and forwards the
+    base's ``transform`` so the DataLoader's batch-level augmentation
+    still applies to split datasets.  When the base has no gather, the
+    attribute is hidden (set to None) so the loader falls back to the
+    per-item collate path instead of crashing."""
+
+    def __init__(self, dataset: Dataset, indices):
+        self.dataset = dataset
+        self.indices = np.asarray(indices, dtype=np.int64)
+        if self.indices.ndim != 1:
+            raise ValueError(f"indices must be 1-D, got shape "
+                             f"{self.indices.shape}")
+        self.transform = getattr(dataset, "transform", None)
+        if getattr(dataset, "gather", None) is None:
+            self.gather = None  # hide the method -> loader collate path
+
+    def __len__(self):
+        return len(self.indices)
+
+    def __getitem__(self, i):
+        return self.dataset[int(self.indices[i])]
+
+    def gather(self, indices: np.ndarray):
+        return self.dataset.gather(self.indices[np.asarray(indices)])
+
+
+class ConcatDataset(Dataset):
+    """Concatenation of datasets (torch ``ConcatDataset`` parity).
+
+    ``gather`` is provided when every child has it: indices are bucketed
+    per child, gathered vectorized, and re-scattered into batch order.
+    The children's ``transform`` must be one shared object (or absent
+    everywhere): this pipeline applies augmentation batch-level in the
+    DataLoader, so per-child transforms cannot be honored — differing
+    transforms raise here rather than silently dropping augmentation."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        if not self.datasets:
+            raise ValueError("ConcatDataset needs at least one dataset")
+        self.cumulative_sizes = np.cumsum([len(d) for d in self.datasets])
+        tfs = [getattr(d, "transform", None) for d in self.datasets]
+        if any(t is not tfs[0] for t in tfs):
+            raise ValueError(
+                "children carry differing transforms; batch-level "
+                "augmentation cannot honor per-child transforms — share "
+                "one transform object across children (or none)")
+        self.transform = tfs[0]
+        if any(getattr(d, "gather", None) is None for d in self.datasets):
+            self.gather = None  # hide the method -> loader collate path
+
+    def __len__(self):
+        return int(self.cumulative_sizes[-1])
+
+    def _locate(self, i: int):
+        if i < 0:
+            i += len(self)
+        if not 0 <= i < len(self):
+            raise IndexError(f"index {i} out of range for {len(self)}")
+        d = int(np.searchsorted(self.cumulative_sizes, i, side="right"))
+        start = 0 if d == 0 else int(self.cumulative_sizes[d - 1])
+        return d, i - start
+
+    def __getitem__(self, i):
+        d, local = self._locate(int(i))
+        return self.datasets[d][local]
+
+    def gather(self, indices: np.ndarray):
+        indices = np.asarray(indices, dtype=np.int64)
+        indices = np.where(indices < 0, indices + len(self), indices)
+        if ((indices < 0) | (indices >= len(self))).any():
+            raise IndexError(f"gather indices out of range for {len(self)}")
+        which = np.searchsorted(self.cumulative_sizes, indices, side="right")
+        starts = np.concatenate([[0], self.cumulative_sizes[:-1]])
+        parts_x, parts_y, order = [], [], []
+        for d in np.unique(which):
+            sel = np.flatnonzero(which == d)
+            x, y = self.datasets[int(d)].gather(indices[sel] - starts[d])
+            parts_x.append(x)
+            parts_y.append(y)
+            order.append(sel)
+        order = np.concatenate(order)
+        inv = np.empty_like(order)
+        inv[order] = np.arange(len(order))
+        return (np.concatenate(parts_x)[inv], np.concatenate(parts_y)[inv])
+
+
+def random_split(dataset: Dataset, lengths, seed: int = 0):
+    """Split into non-overlapping ``Subset``s (torch ``random_split``
+    parity; fractions summing to ~1 are scaled like torch's float form).
+    Deterministic given ``seed`` — pass the same seed on every process so
+    all ranks agree on the split."""
+    lengths = list(lengths)
+    if lengths and all(0.0 < float(l) <= 1.0 for l in lengths) \
+            and abs(sum(float(l) for l in lengths) - 1.0) < 1e-6:
+        n = len(dataset)
+        sizes = [int(np.floor(n * float(f))) for f in lengths]
+        for i in range(n - sum(sizes)):  # distribute the remainder
+            sizes[i % len(sizes)] += 1
+        lengths = sizes
+    if sum(lengths) != len(dataset):
+        raise ValueError(f"sum of lengths {sum(lengths)} != dataset size "
+                         f"{len(dataset)}")
+    perm = np.random.default_rng(seed).permutation(len(dataset))
+    out, off = [], 0
+    for n in lengths:
+        out.append(Subset(dataset, perm[off:off + n]))
+        off += n
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -30,6 +30,8 @@ __all__ = [
     "RandomSampler",
     "BatchSampler",
     "DistributedSampler",
+    "WeightedRandomSampler",
+    "SubsetRandomSampler",
 ]
 
 
@@ -76,6 +78,68 @@ class RandomSampler(Sampler):
 
     def __len__(self):
         return len(self.dataset)
+
+
+class WeightedRandomSampler(Sampler):
+    """Sample ``num_samples`` indices with probability proportional to
+    ``weights`` (torch ``WeightedRandomSampler`` semantics: weights need
+    not sum to 1; ``replacement=False`` draws distinct indices).
+    Deterministic per (seed, epoch) — ``set_epoch`` reshuffles."""
+
+    def __init__(self, weights, num_samples: int, replacement: bool = True,
+                 seed: int = 0):
+        self.weights = np.asarray(weights, dtype=np.float64)
+        if self.weights.ndim != 1 or len(self.weights) == 0:
+            raise ValueError("weights must be a non-empty 1-D sequence")
+        if (self.weights < 0).any():
+            raise ValueError("weights must be non-negative")
+        if self.weights.sum() == 0:
+            raise ValueError("weights must not all be zero")
+        if num_samples <= 0:
+            raise ValueError(f"num_samples must be positive, got "
+                             f"{num_samples}")
+        nonzero = int((self.weights > 0).sum())
+        if not replacement and num_samples > nonzero:
+            raise ValueError(f"cannot draw {num_samples} distinct indices "
+                             f"from {nonzero} positive weights without "
+                             f"replacement")
+        self.num_samples = num_samples
+        self.replacement = replacement
+        self.seed = seed
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __iter__(self):
+        rng = np.random.default_rng((self.seed, self.epoch))
+        p = self.weights / self.weights.sum()
+        idx = rng.choice(len(self.weights), size=self.num_samples,
+                         replace=self.replacement, p=p)
+        return iter(idx.tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class SubsetRandomSampler(Sampler):
+    """Epoch-seeded permutation of a fixed index list (torch
+    ``SubsetRandomSampler`` semantics)."""
+
+    def __init__(self, indices, seed: int = 0):
+        self.indices = np.asarray(indices, dtype=np.int64)
+        self.seed = seed
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __iter__(self):
+        rng = np.random.default_rng((self.seed, self.epoch))
+        return iter(self.indices[rng.permutation(len(self.indices))].tolist())
+
+    def __len__(self):
+        return len(self.indices)
 
 
 class BatchSampler(Sampler):
