@@ -2,6 +2,8 @@
 tracing/metrics rows are bare prints; these are the structured equivalents)."""
 
 from .logging import MetricLogger, rank_zero_print
+from .metrics import accuracy, confusion_matrix, topk_accuracy
 from .profiler import StepTimer, trace
 
-__all__ = ["rank_zero_print", "MetricLogger", "StepTimer", "trace"]
+__all__ = ["rank_zero_print", "MetricLogger", "StepTimer", "trace",
+           "topk_accuracy", "accuracy", "confusion_matrix"]

@@ -1,0 +1,56 @@
+"""Evaluation metrics — jit-friendly counterparts of the torch recipes.
+
+The reference computes accuracy host-side per batch
+(`/root/reference/mpspawn_dist.py:125-131`: argmax + eq + sum).  These
+helpers keep the computation in the XLA graph (device reductions, one
+scalar out) and add the standard top-k form.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["topk_accuracy", "accuracy", "confusion_matrix"]
+
+
+def topk_accuracy(logits, targets, ks: Sequence[int] = (1, 5)):
+    """Fraction of rows whose target is within the top-k logits, for each
+    ``k`` — the torchvision ``accuracy(output, target, topk=(1, 5))``
+    recipe, jit-friendly (one lax.top_k, shared across ks).
+
+    ``logits``: (..., C); ``targets``: (...) int.  Returns a tuple of
+    scalars in [0, 1], one per k, in the order given.
+    """
+    ks = tuple(int(k) for k in ks)
+    c = logits.shape[-1]
+    if not ks or any(k < 1 or k > c for k in ks):
+        raise ValueError(f"every k must be in [1, {c}] and ks non-empty, "
+                         f"got {ks}")
+    flat = logits.reshape(-1, c)
+    tgt = targets.reshape(-1)
+    _, top = jax.lax.top_k(flat, max(ks))          # (N, max_k)
+    hit = top == tgt[:, None]                      # (N, max_k) bool
+    return tuple(hit[:, :k].any(axis=1).mean() for k in ks)
+
+
+def accuracy(logits, targets) -> jax.Array:
+    """Top-1 accuracy as a scalar in [0, 1]."""
+    return (logits.reshape(-1, logits.shape[-1]).argmax(-1)
+            == targets.reshape(-1)).mean()
+
+
+def confusion_matrix(predictions, targets, num_classes: int) -> jax.Array:
+    """(num_classes, num_classes) count matrix, rows = true class, cols =
+    predicted (sklearn orientation).  Scatter-add on device; out-of-range
+    entries are dropped (not clamped into a real class)."""
+    preds = jnp.asarray(predictions).reshape(-1)
+    tgt = jnp.asarray(targets).reshape(-1)
+    valid = ((preds >= 0) & (preds < num_classes)
+             & (tgt >= 0) & (tgt < num_classes))
+    idx = tgt * num_classes + preds
+    counts = jnp.zeros(num_classes * num_classes, jnp.int32).at[
+        jnp.where(valid, idx, 0)].add(valid.astype(jnp.int32))
+    return counts.reshape(num_classes, num_classes)
