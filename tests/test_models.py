@@ -76,3 +76,49 @@ def test_resnet50_param_count():
     model = resnet50(num_classes=1000)
     params = model.init(jax.random.key(0))
     assert n_params(params) == 25_557_032  # torchvision resnet50 @ 1000 cls
+
+
+class TestVGG:
+    """torchvision VGG parity: published parameter counts, head shapes."""
+
+    @pytest.mark.parametrize("name,want", [
+        ("vgg11", 132_863_336), ("vgg13", 133_047_848),
+        ("vgg16", 138_357_544), ("vgg19", 143_667_240),
+        ("vgg11_bn", 132_868_840), ("vgg16_bn", 138_365_992),
+    ])
+    def test_param_counts_match_torchvision(self, name, want):
+        from tpu_dist import models
+        m = getattr(models, name)()
+        params = m.init(jax.random.key(0))
+        assert m.param_count(params) == want
+
+    def test_forward_shape_and_classes(self):
+        from tpu_dist.models import vgg11
+        m = vgg11(num_classes=10)
+        params = m.init(jax.random.key(0))
+        x = np.zeros((2, 32, 32, 3), np.float32)
+        out = jax.jit(lambda p, x: m.apply(p, x))(params, x)
+        assert out.shape == (2, 10)
+
+    def test_bn_variant_trains_with_state(self):
+        from tpu_dist.models import vgg11_bn
+        m = vgg11_bn(num_classes=10)
+        params = m.init(jax.random.key(0))
+        state = m.init_state()
+        x = np.random.default_rng(0).normal(
+            size=(2, 32, 32, 3)).astype(np.float32)
+        out, new_state = m.apply(params, x, state=state, training=True,
+                                 rng=jax.random.key(1))
+        assert out.shape == (2, 10)
+        # a BN running mean moved
+        moved = [float(np.abs(np.asarray(v["mean"])).max())
+                 for k, v in new_state.items() if "mean" in v]
+        assert moved and max(moved) > 0
+
+    def test_dropout_requires_rng_in_training(self):
+        from tpu_dist.models import vgg11
+        m = vgg11(num_classes=10)
+        params = m.init(jax.random.key(0))
+        with pytest.raises(ValueError, match="rng"):
+            m.apply(params, np.zeros((1, 32, 32, 3), np.float32),
+                    training=True)

@@ -143,3 +143,19 @@ def test_sequential_is_iterable():
     assert len(list(iter(seq))) == 2
     with pytest.raises(IndexError):
         seq[5]
+
+
+def test_adaptive_avg_pool_general_bins_match_torch(rng):
+    """Non-divisible and output>input shapes follow torch's bin rule
+    (floor(i*H/out) .. ceil((i+1)*H/out)) — the VGG-on-CIFAR 1x1 -> 7x7
+    case included."""
+    from tpu_dist.nn.layers import AdaptiveAvgPool2d
+
+    for (h, w), (oh, ow) in [((1, 1), (7, 7)), ((5, 7), (3, 2)),
+                             ((10, 3), (7, 7)), ((6, 6), (4, 4))]:
+        x = rng.standard_normal((2, h, w, 3)).astype(np.float32)
+        got = np.asarray(AdaptiveAvgPool2d((oh, ow)).apply({}, x))
+        want = torch.nn.functional.adaptive_avg_pool2d(
+            torch.tensor(x).permute(0, 3, 1, 2),
+            (oh, ow)).permute(0, 2, 3, 1).numpy()
+        np.testing.assert_allclose(got, want, atol=1e-6)

@@ -3,6 +3,10 @@
 from .convnet import ConvNet
 from .resnet import ResNet, resnet18, resnet34, resnet50
 from .transformer import TransformerBlock, TransformerLM
+from .vgg import (VGG, vgg11, vgg11_bn, vgg13, vgg13_bn, vgg16, vgg16_bn,
+                  vgg19, vgg19_bn)
 
 __all__ = ["ConvNet", "ResNet", "resnet18", "resnet34", "resnet50",
-           "TransformerLM", "TransformerBlock"]
+           "TransformerLM", "TransformerBlock",
+           "VGG", "vgg11", "vgg13", "vgg16", "vgg19",
+           "vgg11_bn", "vgg13_bn", "vgg16_bn", "vgg19_bn"]

@@ -1,0 +1,109 @@
+"""VGG family — torchvision-architecture parity, TPU-native implementation.
+
+Rounds out the torchvision-classifier coverage the reference leans on
+(/root/reference/example_mp.py:50 instantiates torchvision models by name;
+ResNet is covered in resnet.py): configs A/B/D/E (vgg11/13/16/19) with the
+optional BatchNorm variants, the 7x7 adaptive-pool + 4096-4096 classifier
+head, and torchvision initialization (kaiming_normal fan_out/relu convs,
+BN weight=1/bias=0, classifier Linear N(0, 0.01)).  Parameter counts match
+torchvision's published numbers exactly (tests/test_models.py).
+
+Layout NHWC; input (batch, H, W, 3).  Like the ResNets, BatchNorm is
+per-replica by default; pass ``bn_axis_name`` for SyncBN.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Union
+
+from .. import nn
+from ..nn import init as init_lib
+from .resnet import _KaimingConv2d
+
+__all__ = ["VGG", "vgg11", "vgg13", "vgg16", "vgg19",
+           "vgg11_bn", "vgg13_bn", "vgg16_bn", "vgg19_bn"]
+
+_CFGS = {
+    "A": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "B": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+          512, 512, "M"],
+    "D": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512,
+          "M", 512, 512, 512, "M"],
+    "E": [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M", 512, 512,
+          512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+class _ClassifierLinear(nn.Linear):
+    """Linear with torchvision VGG classifier init: N(0, 0.01), zero bias."""
+
+    def create_params(self, key):
+        p = {"weight": init_lib.normal(key, (self.in_features,
+                                             self.out_features), std=0.01)}
+        if self.use_bias:
+            p["bias"] = init_lib.zeros((self.out_features,))
+        return p
+
+
+class VGG(nn.Module):
+    def __init__(self, cfg: Union[str, List], num_classes: int = 1000,
+                 batch_norm: bool = False, dropout: float = 0.5,
+                 bn_axis_name: Optional[str] = None):
+        super().__init__()
+        layers: List[nn.Module] = []
+        in_ch = 3
+        for v in (_CFGS[cfg] if isinstance(cfg, str) else cfg):
+            if v == "M":
+                layers.append(nn.MaxPool2d(kernel_size=2, stride=2))
+                continue
+            # torchvision quirk kept for parameter-count parity: the BN
+            # variants do NOT drop the conv bias (unlike ResNet)
+            layers.append(_KaimingConv2d(in_ch, v, kernel_size=3, padding=1,
+                                         bias=True))
+            if batch_norm:
+                layers.append(nn.BatchNorm2d(v, axis_name=bn_axis_name))
+            layers.append(nn.ReLU())
+            in_ch = v
+        self.features = nn.Sequential(*layers)
+        self.avgpool = nn.AdaptiveAvgPool2d((7, 7))
+        self.flatten = nn.Flatten()
+        self.classifier = nn.Sequential(
+            _ClassifierLinear(512 * 7 * 7, 4096), nn.ReLU(),
+            nn.Dropout(dropout),
+            _ClassifierLinear(4096, 4096), nn.ReLU(), nn.Dropout(dropout),
+            _ClassifierLinear(4096, num_classes))
+
+    def forward(self, x):
+        return self.classifier(self.flatten(self.avgpool(self.features(x))))
+
+
+def vgg11(**kw) -> VGG:
+    return VGG("A", **kw)
+
+
+def vgg13(**kw) -> VGG:
+    return VGG("B", **kw)
+
+
+def vgg16(**kw) -> VGG:
+    return VGG("D", **kw)
+
+
+def vgg19(**kw) -> VGG:
+    return VGG("E", **kw)
+
+
+def vgg11_bn(**kw) -> VGG:
+    return VGG("A", batch_norm=True, **kw)
+
+
+def vgg13_bn(**kw) -> VGG:
+    return VGG("B", batch_norm=True, **kw)
+
+
+def vgg16_bn(**kw) -> VGG:
+    return VGG("D", batch_norm=True, **kw)
+
+
+def vgg19_bn(**kw) -> VGG:
+    return VGG("E", batch_norm=True, **kw)

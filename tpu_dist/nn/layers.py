@@ -126,11 +126,19 @@ class AdaptiveAvgPool2d(Module):
     def forward(self, x):
         oh, ow = self.output_size
         n, h, w, c = x.shape
-        if h % oh or w % ow:
-            raise ValueError(
-                f"AdaptiveAvgPool2d: input {h}x{w} not divisible by output "
-                f"{oh}x{ow}")
-        return F.avg_pool2d(x, (h // oh, w // ow))
+        if h % oh == 0 and w % ow == 0:
+            return F.avg_pool2d(x, (h // oh, w // ow))
+        # general torch bin rule — output cell i averages input rows
+        # [floor(i*H/out), ceil((i+1)*H/out)); covers non-divisible shapes
+        # AND output > input (e.g. torchvision VGG pooling 1x1 -> 7x7 on
+        # CIFAR inputs).  Static Python loop: oh + ow row/col reductions,
+        # fixed at trace time, fused by XLA.
+        rows = jnp.stack([
+            x[:, (i * h) // oh: -((-(i + 1) * h) // oh)].mean(axis=1)
+            for i in range(oh)], axis=1)                     # (n, oh, w, c)
+        return jnp.stack([
+            rows[:, :, (j * w) // ow: -((-(j + 1) * w) // ow)].mean(axis=2)
+            for j in range(ow)], axis=2)                     # (n, oh, ow, c)
 
 
 class ReLU(Module):
