@@ -65,3 +65,4 @@ def test_mnist_curve_parity():
         assert ddp_curve[-1] < ddp_curve[0]
     finally:
         dist.destroy_process_group()
+

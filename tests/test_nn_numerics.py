@@ -159,3 +159,64 @@ def test_adaptive_avg_pool_general_bins_match_torch(rng):
             torch.tensor(x).permute(0, 3, 1, 2),
             (oh, ow)).permute(0, 2, 3, 1).numpy()
         np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+class TestCrossEntropyOptions:
+    """label_smoothing / ignore_index / weight vs torch, all combos."""
+
+    @pytest.mark.parametrize("smoothing,weighted,ignore", [
+        (0.0, False, False), (0.1, False, False), (0.0, True, False),
+        (0.0, False, True), (0.1, True, False), (0.1, True, True),
+        (0.0, True, True),
+    ])
+    def test_matches_torch(self, rng, smoothing, weighted, ignore):
+        import torch
+        from tpu_dist import nn as tnn
+
+        logits = rng.standard_normal((12, 7)).astype(np.float32)
+        labels = rng.integers(0, 7, 12).astype(np.int64)
+        if ignore:
+            labels[::3] = -100
+        w = (rng.uniform(0.5, 2.0, 7).astype(np.float32) if weighted
+             else None)
+
+        for reduction in ("mean", "sum", "none"):
+            ours = tnn.CrossEntropyLoss(
+                reduction=reduction, label_smoothing=smoothing,
+                weight=None if w is None else jnp.asarray(w))
+            got = ours(jnp.asarray(logits), jnp.asarray(labels))
+            tl = torch.nn.CrossEntropyLoss(
+                reduction=reduction, label_smoothing=smoothing,
+                weight=None if w is None else torch.tensor(w))
+            want = tl(torch.tensor(logits), torch.tensor(labels))
+            np.testing.assert_allclose(np.asarray(got),
+                                       want.detach().numpy(), rtol=2e-5,
+                                       atol=1e-6,
+                                       err_msg=f"{reduction} s={smoothing} "
+                                               f"w={weighted} ig={ignore}")
+
+    def test_all_ignored_mean_is_finite(self):
+        from tpu_dist import nn as tnn
+        loss = tnn.CrossEntropyLoss()(jnp.zeros((3, 4)),
+                                      jnp.full(3, -100, jnp.int32))
+        assert float(loss) == 0.0  # guarded denominator, not NaN
+
+    def test_fused_rejects_options(self):
+        from tpu_dist import nn as tnn
+        with pytest.raises(ValueError, match="fused"):
+            tnn.CrossEntropyLoss(fused=True, label_smoothing=0.1)
+
+    def test_fused_ignore_index_matches_dense(self, rng):
+        """The fused path masks ignore_index outside the kernel — same
+        numbers as the dense path (pad rows excluded from the mean)."""
+        from tpu_dist import nn as tnn
+        logits = jnp.asarray(rng.standard_normal((16, 32)).astype(np.float32))
+        labels = np.asarray(rng.integers(0, 32, 16))
+        labels[::4] = -100
+        labels = jnp.asarray(labels)
+        for reduction in ("mean", "sum", "none"):
+            fused = tnn.CrossEntropyLoss(reduction=reduction, fused=True)
+            dense = tnn.CrossEntropyLoss(reduction=reduction)
+            np.testing.assert_allclose(
+                np.asarray(fused(logits, labels)),
+                np.asarray(dense(logits, labels)), rtol=1e-5, atol=1e-6)

@@ -128,21 +128,43 @@ def softmax(x, axis: int = -1):
     return jax.nn.softmax(x, axis=axis)
 
 
-def cross_entropy(logits, labels, reduction: str = "mean"):
+def cross_entropy(logits, labels, reduction: str = "mean",
+                  label_smoothing: float = 0.0, ignore_index: int = -100,
+                  weight=None):
     """Softmax cross-entropy with integer labels (torch CrossEntropyLoss).
 
     Matches ``nn.CrossEntropyLoss()`` as used at
-    /root/reference/mpspawn_dist.py:63 and /root/reference/example_mp.py:83.
+    /root/reference/mpspawn_dist.py:63 and /root/reference/example_mp.py:83,
+    including the optional torch semantics:
+
+    - ``label_smoothing``: blend ``(1-eps)*nll + eps*mean_c(-logp_c)``;
+    - ``ignore_index``: rows with this label contribute nothing (and are
+      excluded from the mean's denominator), torch's padding convention;
+    - ``weight``: per-class rescaling; the mean divides by the summed
+      weights of the counted rows, exactly as torch does.
     """
+    labels = labels.astype(jnp.int32)
+    keep = labels != ignore_index
+    safe = jnp.where(keep, labels, 0)  # ignored rows must not index OOB
     logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32),
-                               axis=-1)[..., 0]
+    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    wy = (jnp.asarray(weight)[safe] if weight is not None
+          else jnp.ones_like(nll))
+    loss = nll * wy
+    if label_smoothing:
+        # torch formula: the target term scales by w[y], the uniform term
+        # weights each class's -logp by its own w_c (NOT by w[y])
+        wc = jnp.asarray(weight) if weight is not None else 1.0
+        smooth = -(logp * wc).sum(axis=-1) / logits.shape[-1]
+        loss = (1.0 - label_smoothing) * loss + label_smoothing * smooth
+    wy = jnp.where(keep, wy, 0.0)
+    loss = jnp.where(keep, loss, 0.0)
     if reduction == "mean":
-        return nll.mean()
+        return loss.sum() / jnp.maximum(wy.sum(), jnp.finfo(loss.dtype).tiny)
     if reduction == "sum":
-        return nll.sum()
+        return loss.sum()
     if reduction == "none":
-        return nll
+        return loss
     raise ValueError(f"Unknown reduction {reduction!r}")
 
 

@@ -3,6 +3,8 @@
 
 from __future__ import annotations
 
+import jax.numpy as jnp
+
 from . import functional as F
 from .module import Module
 
@@ -17,16 +19,44 @@ class CrossEntropyLoss(Module):
     row block instead of a materialized log-softmax; worth it for large
     vocabularies (LM heads)."""
 
-    def __init__(self, reduction: str = "mean", fused: bool = False):
+    def __init__(self, reduction: str = "mean", fused: bool = False,
+                 label_smoothing: float = 0.0, ignore_index: int = -100,
+                 weight=None):
         super().__init__()
         self.reduction = reduction
         self.fused = fused
+        self.label_smoothing = label_smoothing
+        self.ignore_index = ignore_index
+        self.weight = weight
+        if fused and (label_smoothing or weight is not None):
+            raise ValueError(
+                "the fused Pallas kernel computes plain softmax CE; use "
+                "fused=False with label_smoothing/weight (ignore_index IS "
+                "supported on the fused path)")
 
     def forward(self, logits, labels):
         if self.fused:
             from ..ops import fused_cross_entropy
-            return fused_cross_entropy(logits, labels, self.reduction)
-        return F.cross_entropy(logits, labels, self.reduction)
+            labels = labels.astype(jnp.int32)
+            keep = labels != self.ignore_index
+            # the kernel matches labels by column id, so an out-of-range
+            # sentinel (-100) would silently yield nll = lse; mask outside
+            safe = jnp.where(keep, labels, 0)
+            nll = fused_cross_entropy(logits, safe, "none")
+            nll = jnp.where(keep, nll, 0.0)
+            if self.reduction == "mean":
+                n = keep.sum().astype(nll.dtype)
+                return nll.sum() / jnp.maximum(n,
+                                               jnp.finfo(nll.dtype).tiny)
+            if self.reduction == "sum":
+                return nll.sum()
+            if self.reduction == "none":
+                return nll
+            raise ValueError(f"Unknown reduction {self.reduction!r}")
+        return F.cross_entropy(logits, labels, self.reduction,
+                               label_smoothing=self.label_smoothing,
+                               ignore_index=self.ignore_index,
+                               weight=self.weight)
 
     # Losses carry no parameters, so allow calling outside apply() too.
     def __call__(self, logits, labels):
