@@ -1,0 +1,140 @@
+"""FSDP (ZeRO-3 via GSPMD placements): sharded params/opt-state train with
+numerics identical to the single-device step."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import tpu_dist.dist as dist
+from tpu_dist import nn, optim
+from tpu_dist.models import TransformerLM
+from tpu_dist.parallel import fsdp_shard, fsdp_specs, make_gspmd_train_step
+
+VOCAB, DIM, T = 33, 64, 16
+
+
+@pytest.fixture(autouse=True)
+def _pg_cleanup():
+    yield
+    if dist.is_initialized():
+        dist.destroy_process_group()
+
+
+def test_fsdp_specs_shard_largest_divisible_dim(eight_devices):
+    dist.init_process_group(backend="cpu")
+    mesh = dist.get_default_group().mesh
+    tree = {"w": jnp.zeros((48, 8)),        # 48 % 8 == 0 -> shard dim 0
+            "tall": jnp.zeros((7, 4096)),   # dim0 indivisible -> dim 1
+            "bias": jnp.zeros((4096,)),     # 1-D, large -> sharded
+            "tiny": jnp.zeros((64,)),       # < min_size -> replicated
+            "odd": jnp.zeros((7, 9))}       # nothing divisible -> replicated
+    specs = fsdp_specs(tree, mesh, axis="data", min_size=256)
+    assert specs["w"] == P("data", None)
+    assert specs["tall"] == P(None, "data")
+    assert specs["bias"] == P("data")
+    assert specs["tiny"] == P()
+    assert specs["odd"] == P()
+
+
+def test_fsdp_step_matches_single_device(eight_devices):
+    dist.init_process_group(backend="cpu")
+    pg = dist.get_default_group()
+    model = TransformerLM(vocab_size=VOCAB, dim=DIM, depth=2, num_heads=4,
+                          max_seq_len=T)
+    ce = nn.CrossEntropyLoss()
+    loss_fn = lambda lg, y: ce(lg.reshape(-1, VOCAB), y.reshape(-1))
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, VOCAB, (16, T)))
+    y = jnp.asarray(rng.integers(0, VOCAB, (16, T)))
+
+    params0 = model.init(jax.random.key(0))
+    # oracle first: the sharded step donates its inputs
+    opt = optim.AdamW(lr=1e-3)
+
+    def objective(p):
+        return loss_fn(model.apply(p, x), y)
+
+    loss_ref, grads = jax.value_and_grad(objective)(params0)
+    ref_p, _ = opt.update(grads, opt.init(params0), params0)
+
+    params = fsdp_shard(params0, pg.mesh, min_size=256)
+    opt_state = fsdp_shard(opt.init(params), pg.mesh, min_size=256)
+    # ZeRO-3 placement actually happened: the embedding is sharded 1/8
+    emb = params["tok"]["weight"]
+    assert emb.sharding.spec != P()
+    assert len(emb.sharding.device_set) == 8
+    shard_elems = np.prod(emb.sharding.shard_shape(emb.shape))
+    assert shard_elems == emb.size // 8
+    # Adam moments sharded with their params
+    m_emb = opt_state["m"]["tok"]["weight"]
+    assert m_emb.sharding.spec == emb.sharding.spec
+
+    step = make_gspmd_train_step(model, loss_fn, opt)
+    bsh = NamedSharding(pg.mesh, P("data", None))
+    new_p, new_opt, metrics = step(params, opt_state,
+                                   jax.device_put(x, bsh),
+                                   jax.device_put(y, bsh))
+    np.testing.assert_allclose(float(metrics["loss"]), float(loss_ref),
+                               rtol=1e-5)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), atol=2e-5), jax.device_get(new_p),
+        ref_p)
+    # updated params keep their FSDP placement (no silent re-replication)
+    assert new_p["tok"]["weight"].sharding.spec == emb.sharding.spec
+
+
+def test_fsdp_multi_step_trains(eight_devices):
+    """Loss falls over steps with params staying sharded throughout."""
+    dist.init_process_group(backend="cpu")
+    pg = dist.get_default_group()
+    model = TransformerLM(vocab_size=VOCAB, dim=DIM, depth=2, num_heads=4,
+                          max_seq_len=T)
+    ce = nn.CrossEntropyLoss()
+    loss_fn = lambda lg, y: ce(lg.reshape(-1, VOCAB), y.reshape(-1))
+    opt = optim.AdamW(lr=3e-3)
+    params = fsdp_shard(model.init(jax.random.key(0)), pg.mesh, min_size=256)
+    opt_state = fsdp_shard(opt.init(params), pg.mesh, min_size=256)
+    step = make_gspmd_train_step(model, loss_fn, opt)
+
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, VOCAB, (16, T))
+    bsh = NamedSharding(pg.mesh, P("data", None))
+    xj = jax.device_put(jnp.asarray(x), bsh)
+    yj = jax.device_put(jnp.asarray((x + 1) % VOCAB), bsh)
+    first = last = None
+    for i in range(15):
+        params, opt_state, m = step(params, opt_state, xj, yj)
+        if i == 0:
+            first = float(m["loss"])
+        last = float(m["loss"])
+    assert first / last > 2, (first, last)
+
+
+def test_fsdp_none_leaves_pass_through(eight_devices):
+    dist.init_process_group(backend="cpu")
+    mesh = dist.get_default_group().mesh
+    out = fsdp_shard({"a": jnp.zeros((16, 8)), "b": None}, mesh, min_size=8)
+    assert out["b"] is None
+    assert out["a"].sharding.spec == P("data", None)
+
+
+def test_fsdp_composes_with_tp_rules(eight_devices):
+    """TP-first-then-FSDP: TP-sharded leaves keep their placement, the
+    remaining replicated leaves get data-sharded — the docstring recipe."""
+    from tpu_dist.parallel import TRANSFORMER_TP_RULES, shard_pytree
+    dist.init_process_group(backend="cpu", axis_names=("data", "model"),
+                            mesh_shape=(2, 4))
+    mesh = dist.get_default_group().mesh
+    # vocab must divide the 4-wide 'model' axis for the TP rules
+    model = TransformerLM(vocab_size=32, dim=32, depth=1, num_heads=4,
+                          max_seq_len=T)
+    params = shard_pytree(model.init(jax.random.key(0)), mesh,
+                          TRANSFORMER_TP_RULES)
+    qkv_before = params["block0.attn"]["qkv_weight"].sharding.spec
+    assert qkv_before == P(None, "model")
+    params = fsdp_shard(params, mesh, min_size=128)
+    # TP placement survives; a previously-replicated large leaf sharded
+    assert params["block0.attn"]["qkv_weight"].sharding.spec == qkv_before
+    assert params["pos"]["weight"].sharding.spec != P()

@@ -6,6 +6,7 @@ axis_names/mesh_shape)."""
 
 from .ddp import (DistributedDataParallel, TrainState,
                   convert_sync_batchnorm)
+from .fsdp import fsdp_shard, fsdp_specs
 from .gspmd import (MOE_EP_RULES, PartitionRules, TRANSFORMER_TP_RULES,
                     make_gspmd_train_step, shard_pytree)
 from .pipeline import PipelineParallel, PipeTrainState
@@ -19,4 +20,5 @@ __all__ = ["DistributedDataParallel", "DDP", "TrainState",
            "PartitionRules", "TRANSFORMER_TP_RULES", "MOE_EP_RULES",
            "make_gspmd_train_step", "shard_pytree",
            "PipelineParallel", "PipeTrainState",
+           "fsdp_shard", "fsdp_specs",
            "ring_self_attention", "ulysses_self_attention"]
