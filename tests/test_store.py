@@ -18,12 +18,11 @@ def store(request, monkeypatch, tmp_path):
         pytest.skip("native toolchain unavailable")
     if request.param == "python":
         monkeypatch.setenv("TPU_DIST_PURE_PYTHON_STORE", "1")
-        import tpu_dist.dist.store as S
-        monkeypatch.setattr(S, "_native_tried", False)
-        monkeypatch.setattr(S, "_native_lib", None)
+        _load_native.reset()
     s = TCPStore(is_master=True)
     yield s
     s.close()
+    _load_native.reset()
 
 
 class TestStoreOps:
@@ -154,15 +153,17 @@ class TestInterop:
 
     def test_native_falls_back_cleanly(self, monkeypatch):
         monkeypatch.setenv("TPU_DIST_PURE_PYTHON_STORE", "1")
-        import tpu_dist.dist.store as S
-        monkeypatch.setattr(S, "_native_tried", False)
-        monkeypatch.setattr(S, "_native_lib", None)
+        _load_native.reset()
         s = TCPStore(is_master=True)
-        assert not s.native
-        assert isinstance(s._server, PyTCPStoreServer)
-        s.set("a", b"b")
-        assert s.get("a") == b"b"
-        s.close()
+        try:
+            assert not s.native
+            assert isinstance(s._server, PyTCPStoreServer)
+            s.set("a", b"b")
+            assert s.get("a") == b"b"
+        finally:
+            s.close()
+            monkeypatch.delenv("TPU_DIST_PURE_PYTHON_STORE")
+            _load_native.reset()
 
 
 class TestFileStore:

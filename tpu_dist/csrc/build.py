@@ -12,9 +12,11 @@ import subprocess
 import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SOURCES = [os.path.join(_DIR, "tcpstore.cpp")]
+_SOURCES = [os.path.join(_DIR, "tcpstore.cpp"),
+            os.path.join(_DIR, "image_ops.cpp")]
 _LIB = os.path.join(_DIR, "libtpudist.so")
-_lock = threading.Lock()
+# RLock: load_native holds it while calling ensure_built (same lock)
+_lock = threading.RLock()
 
 
 class NativeBuildError(RuntimeError):
@@ -23,6 +25,33 @@ class NativeBuildError(RuntimeError):
 
 def lib_path() -> str:
     return _LIB
+
+
+def load_native(env_disable: str, bind):
+    """Shared lazy native-loader idiom: build + dlopen ``libtpudist.so``
+    once (thread-safe), call ``bind(lib)`` to declare/bind symbols, and
+    return its result — or None forever after the first failure or when
+    ``env_disable`` is set.  Serves the store (dist/store.py) and the
+    image kernels (data/_native.py)."""
+    import ctypes
+
+    cache = {}
+
+    def loader():
+        with _lock:
+            if "v" in cache:
+                return cache["v"]
+            result = None
+            if not os.environ.get(env_disable):
+                try:
+                    result = bind(ctypes.CDLL(ensure_built()))
+                except Exception:
+                    result = None
+            cache["v"] = result
+            return result
+
+    loader.reset = cache.clear  # tests: re-evaluate after env changes
+    return loader
 
 
 def _stale() -> bool:
@@ -49,7 +78,7 @@ def ensure_built(quiet: bool = True) -> str:
                 if not _stale():  # another process built it while we waited
                     return _LIB
                 tmp = f"{_LIB}.{os.getpid()}.tmp"
-                cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
                        "-pthread", "-o", tmp] + _SOURCES
                 try:
                     proc = subprocess.run(cmd, capture_output=True, text=True,

@@ -1,0 +1,75 @@
+"""ctypes bridge to the native image kernels (csrc/image_ops.cpp).
+
+Lazy, optional, and silent: the first call builds/loads ``libtpudist.so``;
+any failure (no g++, sandboxed filesystem) or ``TPU_DIST_PURE_PYTHON_IMAGE=1``
+permanently falls back to the numpy reference implementation in
+transforms.py — which stays the parity oracle either way."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["bilinear_crop_resize"]
+
+def _bind(lib):
+    fn = lib.tpu_dist_bilinear_crop_resize
+    fn.restype = ctypes.c_int
+    fn.argtypes = [
+        ctypes.POINTER(ctypes.c_float),                  # x
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,  # n, h, w
+        ctypes.c_int64,                                  # c
+        ctypes.POINTER(ctypes.c_float),                  # top
+        ctypes.POINTER(ctypes.c_float),                  # left
+        ctypes.POINTER(ctypes.c_float),                  # crop_h
+        ctypes.POINTER(ctypes.c_float),                  # crop_w
+        ctypes.c_int64, ctypes.c_int64,                  # oh, ow
+        ctypes.POINTER(ctypes.c_float),                  # out
+    ]
+    return fn
+
+
+def _make_loader():
+    from ..csrc.build import load_native
+    return load_native("TPU_DIST_PURE_PYTHON_IMAGE", _bind)
+
+
+_load = _make_loader()
+
+
+def bilinear_crop_resize(x: np.ndarray, top: np.ndarray, left: np.ndarray,
+                         crop_h: np.ndarray, crop_w: np.ndarray,
+                         out_hw) -> Optional[np.ndarray]:
+    """Native batched bilinear crop+resize; None if unavailable (caller
+    falls back to numpy).  Arguments mirror transforms._bilinear_crop_resize."""
+    fn = _load()
+    if fn is None:
+        return None
+    x = np.ascontiguousarray(x, np.float32)
+    n, h, w, c = x.shape
+    oh, ow = out_hw
+    out = np.empty((n, oh, ow, c), np.float32)
+    top, left = (np.ascontiguousarray(v, np.float32) for v in (top, left))
+    crop_h, crop_w = (np.ascontiguousarray(v, np.float32)
+                      for v in (crop_h, crop_w))
+    # raw pointers cross the ABI below: malformed boxes must fail HERE as a
+    # Python error (the numpy fallback's behavior), never as OOB reads or
+    # floor(NaN)->int64 UB inside the C loop
+    for name, v in (("top", top), ("left", left),
+                    ("crop_h", crop_h), ("crop_w", crop_w)):
+        if v.shape != (n,):
+            raise ValueError(f"{name} must have shape ({n},), got {v.shape}")
+        if not np.isfinite(v).all():
+            raise ValueError(f"{name} contains non-finite values")
+    rc = fn(x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            n, h, w, c,
+            top.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            left.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            crop_h.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            crop_w.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            oh, ow,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+    return out if rc == 0 else None

@@ -319,22 +319,7 @@ class _NativeClient:
             self._h = None
 
 
-_native_lib = None
-_native_tried = False
-
-
-def _load_native():
-    global _native_lib, _native_tried
-    if _native_tried:
-        return _native_lib
-    _native_tried = True
-    if os.environ.get("TPU_DIST_PURE_PYTHON_STORE"):
-        return None
-    try:
-        from ..csrc.build import ensure_built
-        lib = ctypes.CDLL(ensure_built())
-    except Exception:
-        return None
+def _bind_store(lib):
     lib.tpudist_store_server_start.restype = ctypes.c_void_p
     lib.tpudist_store_server_start.argtypes = [ctypes.c_int]
     lib.tpudist_store_server_port.restype = ctypes.c_int
@@ -367,8 +352,15 @@ def _load_native():
     lib.tpudist_store_wait_ge.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong]
     lib.tpudist_store_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
-    _native_lib = lib
     return lib
+
+
+def _make_loader():
+    from ..csrc.build import load_native
+    return load_native("TPU_DIST_PURE_PYTHON_STORE", _bind_store)
+
+
+_load_native = _make_loader()
 
 
 class TCPStore(Store):
@@ -397,6 +389,7 @@ class TCPStore(Store):
             host = "127.0.0.1" if host in ("0.0.0.0", "") else host
         self.host, self.port = host, port
         self.native = lib is not None
+        self._lib = lib  # close() must stop the server with the same lib
         self._client = (_NativeClient(lib, host, port, timeout)
                         if lib is not None
                         else _PyClient(host, port, timeout))
@@ -438,7 +431,7 @@ class TCPStore(Store):
             self._server.stop()
             self._server = None
         if self._native_server:
-            _native_lib.tpudist_store_server_stop(self._native_server)
+            self._lib.tpudist_store_server_stop(self._native_server)
             self._native_server = None
 
     def __enter__(self):
