@@ -23,6 +23,14 @@ from ..nn.module import current_context
 __all__ = ["TransformerLM", "TransformerBlock"]
 
 
+def _norm_cls(norm: str):
+    if norm == "layernorm":
+        return nn.LayerNorm
+    if norm == "rmsnorm":
+        return nn.RMSNorm
+    raise ValueError(f"Unknown norm {norm!r} (layernorm|rmsnorm)")
+
+
 def _run_capturing_state(block, x):
     """Run ``block(x)`` with the apply-context's state-update sink swapped
     for a fresh dict, returning ``(output, captured_updates)`` — so a
@@ -44,13 +52,16 @@ def _run_capturing_state(block, x):
 class TransformerBlock(nn.Module):
     def __init__(self, dim: int, num_heads: int, causal: bool = True,
                  sequence_axis: Optional[str] = None, mode: str = "ring",
-                 mlp: Optional[nn.Module] = None):
+                 mlp: Optional[nn.Module] = None, norm: str = "layernorm",
+                 rope: bool = False, rope_theta: float = 10000.0):
         super().__init__()
-        self.ln1 = nn.LayerNorm(dim)
+        norm_cls = _norm_cls(norm)
+        self.ln1 = norm_cls(dim)
         self.attn = nn.MultiheadSelfAttention(dim, num_heads, causal=causal,
                                               sequence_axis=sequence_axis,
-                                              mode=mode)
-        self.ln2 = nn.LayerNorm(dim)
+                                              mode=mode, rope=rope,
+                                              rope_theta=rope_theta)
+        self.ln2 = norm_cls(dim)
         # mlp override: e.g. an nn.MoELayer for mixture-of-experts blocks
         self.mlp = mlp if mlp is not None else nn.Sequential(
             nn.Linear(dim, 4 * dim), nn.GELU(), nn.Linear(4 * dim, dim))
@@ -77,24 +88,33 @@ class TransformerLM(nn.Module):
                  causal: bool = True, sequence_axis: Optional[str] = None,
                  mode: str = "ring", remat: bool = False,
                  num_experts: int = 0, moe_top_k: int = 2,
-                 moe_every: int = 1, moe_capacity_factor: float = 1.25):
+                 moe_every: int = 1, moe_capacity_factor: float = 1.25,
+                 norm: str = "layernorm", rope: bool = False,
+                 rope_theta: float = 10000.0):
         """``num_experts > 0`` makes every ``moe_every``-th block's MLP a
         routed :class:`~tpu_dist.nn.MoELayer` (expert-parallel under
         :data:`~tpu_dist.parallel.MOE_EP_RULES`); aux load-balance losses
-        surface in the model state, see nn/moe.py."""
+        surface in the model state, see nn/moe.py.
+
+        ``norm="rmsnorm"`` + ``rope=True`` gives the LLaMA-family recipe:
+        RMS normalization and rotary position embeddings instead of the
+        learned position table (``self.pos`` is then absent — attention
+        scores depend only on relative distance)."""
         super().__init__()
         if num_experts > 0 and moe_every < 1:
             raise ValueError(f"moe_every must be >= 1, got {moe_every}")
         self.vocab_size = vocab_size
         self.max_seq_len = max_seq_len
         self.num_experts = num_experts
+        self.rope = rope
         self.tok = nn.Embedding(vocab_size, dim)
-        self.pos = nn.Embedding(max_seq_len, dim)
+        self.pos = None if rope else nn.Embedding(max_seq_len, dim)
         for i in range(depth):
             moe = (num_experts > 0 and i % moe_every == moe_every - 1)
             setattr(self, f"block{i}", TransformerBlock(
                 dim, num_heads, causal=causal,
-                sequence_axis=sequence_axis, mode=mode,
+                sequence_axis=sequence_axis, mode=mode, norm=norm,
+                rope=rope, rope_theta=rope_theta,
                 mlp=nn.MoELayer(dim, num_experts, top_k=moe_top_k,
                                 capacity_factor=moe_capacity_factor)
                 if moe else None))
@@ -107,7 +127,7 @@ class TransformerLM(nn.Module):
         # (per-layer residual-boundary policy, like torch's
         # checkpoint_sequential over blocks)
         self.remat = remat
-        self.ln_f = nn.LayerNorm(dim)
+        self.ln_f = _norm_cls(norm)(dim)
         self.head = nn.Linear(dim, vocab_size)
 
     def forward(self, idx, pos_offset=None):
@@ -118,7 +138,11 @@ class TransformerLM(nn.Module):
                 pos_offset = lax.axis_index(self.sequence_axis) * t
             else:
                 pos_offset = 0
-        x = self.tok(idx) + self.pos(pos_offset + jnp.arange(t))
+        if self.pos is not None:
+            x = self.tok(idx) + self.pos(pos_offset + jnp.arange(t))
+        else:
+            # rope: positions enter through the attention rotations
+            x = self.tok(idx)
         # remat is a training-memory trade; during cached decode it must be
         # off — the attention layers' put_state writes would leak tracers
         # out of the jax.checkpoint sub-trace (and inference keeps no

@@ -3,10 +3,10 @@ SURVEY.md §1)."""
 
 from . import functional, init
 from .attention import (MultiheadSelfAttention, attention_impl,
-                        scaled_dot_product_attention)
+                        rotary_embed, scaled_dot_product_attention)
 from .layers import (AdaptiveAvgPool2d, AvgPool2d, BatchNorm2d, Conv2d,
                      Dropout, Embedding, Flatten, GELU, Identity, LayerNorm,
-                     Linear, MaxPool2d, ReLU)
+                     Linear, MaxPool2d, ReLU, RMSNorm)
 from .loss import CrossEntropyLoss
 from .moe import MoELayer
 from .module import Module, Sequential
@@ -15,8 +15,8 @@ __all__ = [
     "Module", "Sequential", "functional", "init",
     "Linear", "Conv2d", "MaxPool2d", "AvgPool2d", "AdaptiveAvgPool2d",
     "ReLU", "Flatten", "Dropout", "BatchNorm2d", "Identity",
-    "Embedding", "LayerNorm", "GELU",
+    "Embedding", "LayerNorm", "RMSNorm", "GELU",
     "MultiheadSelfAttention", "scaled_dot_product_attention",
-    "attention_impl", "MoELayer",
+    "attention_impl", "MoELayer", "rotary_embed",
     "CrossEntropyLoss",
 ]

@@ -26,7 +26,7 @@ from .module import Module
 from . import init as I
 
 __all__ = ["scaled_dot_product_attention", "MultiheadSelfAttention",
-           "attention_impl"]
+           "attention_impl", "rotary_embed"]
 
 _IMPL_OVERRIDE: list = []
 
@@ -91,6 +91,27 @@ def scaled_dot_product_attention(q, k, v, causal: bool = False,
     return jnp.einsum("...hqk,...khd->...qhd", w, v)
 
 
+def rotary_embed(x, positions, theta: float = 10000.0):
+    """Rotate ``x`` (..., T, H, D) by per-position angles — RoPE (Su et al.,
+    arXiv:2104.09864), rotate-half convention.  ``positions``: (T,) int
+    absolute positions; attention scores then depend only on relative
+    distance, so no learned position table is needed and contexts
+    extrapolate.  Angles computed in f32, result cast back to x.dtype."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) * 2.0 / d)
+    ang = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # (T, half)
+    # (T, 1, half) broadcasts against (..., T, H, half) for ANY number of
+    # leading batch dims (including none)
+    cos = jnp.cos(ang)[:, None, :]
+    sin = jnp.sin(ang)[:, None, :]
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(
+        jnp.float32)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                          axis=-1)
+    return out.astype(x.dtype)
+
+
 class MultiheadSelfAttention(Module):
     """Multi-head self-attention with fused QKV projection.
 
@@ -103,13 +124,17 @@ class MultiheadSelfAttention(Module):
 
     def __init__(self, embed_dim: int, num_heads: int, bias: bool = True,
                  causal: bool = False, sequence_axis: Optional[str] = None,
-                 mode: str = "ring", attn_impl: Optional[str] = None):
+                 mode: str = "ring", attn_impl: Optional[str] = None,
+                 rope: bool = False, rope_theta: float = 10000.0):
         super().__init__()
         if embed_dim % num_heads:
             raise ValueError(f"embed_dim {embed_dim} not divisible by "
                              f"num_heads {num_heads}")
         if mode not in ("ring", "ulysses"):
             raise ValueError(f"Unknown sequence-parallel mode {mode!r}")
+        if rope and (embed_dim // num_heads) % 2:
+            raise ValueError(f"rotary embeddings need an even head_dim, "
+                             f"got {embed_dim // num_heads}")
         self.embed_dim = embed_dim
         self.num_heads = num_heads
         self.head_dim = embed_dim // num_heads
@@ -118,6 +143,8 @@ class MultiheadSelfAttention(Module):
         self.sequence_axis = sequence_axis
         self.mode = mode
         self.attn_impl = attn_impl  # None=auto | "dense" | "flash"
+        self.rope = rope
+        self.rope_theta = rope_theta
 
     def create_params(self, key):
         k1, k2 = jax.random.split(key)
@@ -138,6 +165,21 @@ class MultiheadSelfAttention(Module):
         qkv = F.linear(x, p["qkv_weight"], p.get("qkv_bias"))
         qkv = qkv.reshape(b, t, 3, self.num_heads, self.head_dim)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if self.rope:
+            # absolute positions of THESE tokens: the cache write index
+            # during decode, the shard offset under sequence parallelism,
+            # 0 otherwise.  Keys are cached post-rotation, so the decode
+            # path needs no re-rotation of the prefix.
+            if ctx.state is not None and self._path in ctx.state:
+                offset = ctx.get_state(self._path)["index"]
+            elif self.sequence_axis is not None:
+                from jax import lax
+                offset = lax.axis_index(self.sequence_axis) * t
+            else:
+                offset = 0
+            pos = offset + jnp.arange(t)
+            q = rotary_embed(q, pos, self.rope_theta)
+            k = rotary_embed(k, pos, self.rope_theta)
         if ctx.state is not None and self._path in ctx.state:
             # autoregressive decode: a KV cache was allocated for this layer
             # (TransformerLM.init_cache) — append this call's K/V at the

@@ -313,6 +313,40 @@ class LayerNorm(Module):
         return f"LayerNorm({self.normalized_shape})"
 
 
+class RMSNorm(Module):
+    """Root-mean-square normalization (torch ``nn.RMSNorm`` parity;
+    Zhang & Sennrich, arXiv:1910.07467) — no mean subtraction, no bias,
+    the LLaMA-family default.  Statistics in f32, result in x.dtype."""
+
+    def __init__(self, normalized_shape, eps: float = 1e-6,
+                 elementwise_affine: bool = True):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self.normalized_shape = tuple(normalized_shape)
+        self.eps = eps
+        self.elementwise_affine = elementwise_affine
+
+    def create_params(self, key):
+        if not self.elementwise_affine:
+            return None
+        return {"weight": jnp.ones(self.normalized_shape)}
+
+    def forward(self, x):
+        axes = tuple(range(x.ndim - len(self.normalized_shape), x.ndim))
+        xf = x.astype(jnp.float32)
+        y = xf * lax.rsqrt(jnp.mean(jnp.square(xf), axes, keepdims=True)
+                           + self.eps)
+        y = y.astype(x.dtype)
+        if self.elementwise_affine:
+            w = _ctx().get_params(self._path)["weight"]
+            y = y * w.astype(x.dtype)  # keep the promised output dtype
+        return y
+
+    def __repr__(self):
+        return f"RMSNorm({self.normalized_shape})"
+
+
 class GELU(Module):
     """Gaussian error linear unit (exact erf form, torch default)."""
 

@@ -67,7 +67,9 @@ class PipeTrainState(NamedTuple):
 
 class _Embed(nn.Module):
     """Token + learned positional embeddings (the model's own modules, so
-    parameter pytrees transfer 1:1 between pipeline and plain layouts)."""
+    parameter pytrees transfer 1:1 between pipeline and plain layouts).
+    ``pos`` is None for rope models — positions then enter through the
+    attention rotations inside the stages."""
 
     def __init__(self, tok, pos):
         super().__init__()
@@ -75,6 +77,8 @@ class _Embed(nn.Module):
         self.pos = pos
 
     def forward(self, idx):
+        if self.pos is None:
+            return self.tok(idx)
         t = idx.shape[1]
         return self.tok(idx) + self.pos(jnp.arange(t))
 
@@ -184,8 +188,10 @@ class PipelineParallel:
             names = src(0).keys()
             stages[canon] = {n: jnp.stack([src(st)[n] for st in range(s)])
                             for n in names}
-        repl = {"embed": {"tok": model_params["tok"],
-                          "pos": model_params["pos"]},
+        embed = {"tok": model_params["tok"]}
+        if "pos" in model_params:
+            embed["pos"] = model_params["pos"]
+        repl = {"embed": embed,
                 "head": {"ln_f": model_params["ln_f"],
                          "head": model_params["head"]}}
         return {"repl": repl, "stages": stages}
@@ -195,9 +201,10 @@ class PipelineParallel:
         layout or hand weights to an unsharded model for decoding."""
         k = self.blocks_per_stage
         out = {"tok": pipe_params["repl"]["embed"]["tok"],
-               "pos": pipe_params["repl"]["embed"]["pos"],
                "ln_f": pipe_params["repl"]["head"]["ln_f"],
                "head": pipe_params["repl"]["head"]["head"]}
+        if "pos" in pipe_params["repl"]["embed"]:
+            out["pos"] = pipe_params["repl"]["embed"]["pos"]
         for canon, j, suffix in self._stage_paths():
             stacked = pipe_params["stages"][canon]
             for st in range(self.num_stages):
