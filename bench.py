@@ -9,31 +9,27 @@ through the DistributedDataParallel wrapper over all available devices — on
 the axon-tunnel chip that is 1×TPU v5e; under
 ``xla_force_host_platform_device_count=8`` it is the 8-core scenario.
 
-Headline configuration (round 2): **mixed-precision bf16** —
-``compute_dtype=bfloat16`` runs forward/backward on the MXU in bf16 while
-parameters, gradients, and optimizer state stay float32 master copies (the
-standard TPU training recipe; numerics validated by the mixed-precision
-tests in tests/test_ddp_features.py), with ``donate=True`` so the train
-state is updated in place.  ``BENCH_DTYPE=float32`` reproduces the pure-f32
-configuration of the round-1 recording.  The printed JSON carries a
-``dtype`` field so recordings at different precisions are distinguishable
-(the round-1 BENCH_BASELINE.json value 624,842 was float32).
+Headline configuration (round 2): **bf16 mixed precision + scanned steps**.
 
-Where round 1's 9% bench drop went (VERDICT.md Weak #2): it was NOT the
-ddp.py rework — a minimal hand-rolled step (no accumulation scaffolding, no
-metrics) times identically to the wrapper's fast path on the chip.  It was
-(a) ``donate=False`` in the round-1 bench.py forcing fresh output buffers
-every step, and (b) axon-tunnel day-to-day variance (the same round-1
-configuration re-measured 500-580k img/s across runs on the same code).
-Recovery: buffer donation + best-of-3 chained timing + the bf16
-mixed-precision compute path, which at batch 2048 measures ~780-900k
-img/s/chip vs the 624,842 f32 recording (~1.3x).
+- ``compute_dtype=bfloat16`` runs forward/backward on the MXU in bf16 while
+  parameters, gradients, and optimizer state stay float32 master copies
+  (numerics validated in tests/test_ddp_features.py).
+- ``ddp.train_chunk`` executes BENCH_STEPS fused steps per host dispatch as
+  a ``lax.scan`` (one XLA program, one readback).  Measuring per-step
+  dispatch over the axon tunnel (~100ms RTT, heavy minute-scale throughput
+  drift from chip sharing) made round-1-style chained timing swing 2-3x
+  between runs — with scanned steps each measurement is two RTTs total;
+  min-over-reps estimates uncontended chip speed, and a long-minus-short
+  chunk difference cancels the remaining constant dispatch overhead.
+- Per-chip batch 8192: at 2048 the per-step kernels are too small to fill
+  the v5e under contention (measured 263k img/s at 2048 vs 602k at 8192 on
+  a contended interval; both >900k uncontended at bf16).
+- Step inputs are generated ON DEVICE (jitted PRNG) — nothing rides the
+  tunnel but the dispatch and the final scalar readback.
 
-Timing discipline for the axon tunnel (~100ms RTT): steps are chained
-on-device (state dependency) with ONE host readback at the end; the
-constant readback/dispatch overhead cancels in the (long - short chain)
-difference.  NOTE: ``jax.block_until_ready`` does NOT wait for remote
-execution on the tunnel — only a host readback truly syncs.
+``BENCH_DTYPE=float32 BENCH_BATCH=2048`` reproduces the round-1 recording's
+configuration (which measured 624,842 img/s f32; the printed JSON carries
+``dtype`` so recordings at different precisions are distinguishable).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
@@ -47,7 +43,6 @@ import time
 def main():
     import jax
     import jax.numpy as jnp
-    import numpy as np
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import tpu_dist.dist as dist
@@ -56,10 +51,10 @@ def main():
     from tpu_dist.parallel import DistributedDataParallel
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    per_chip_batch = int(os.environ.get("BENCH_BATCH", 2048))
-    steps = int(os.environ.get("BENCH_STEPS", 100))
-    warmup = max(1, int(os.environ.get("BENCH_WARMUP", 5)))
-    reps = max(1, int(os.environ.get("BENCH_REPS", 3)))
+    per_chip_batch = int(os.environ.get("BENCH_BATCH", 8192))
+    steps = max(2, int(os.environ.get("BENCH_STEPS", 50)))
+    warmup = max(1, int(os.environ.get("BENCH_WARMUP", 1)))
+    reps = max(1, int(os.environ.get("BENCH_REPS", 8)))
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
     compute_dtype = None if dtype == "float32" else jnp.dtype(dtype)
 
@@ -72,27 +67,44 @@ def main():
         loss_fn=nn.CrossEntropyLoss(), group=pg, donate=True,
         compute_dtype=compute_dtype)
 
-    rng = np.random.default_rng(0)
-    sharding = NamedSharding(pg.mesh, P(pg.axis_name))
-    x = jax.device_put(rng.normal(size=(batch, 28, 28, 1)).astype(np.float32),
-                       sharding)
-    y = jax.device_put(rng.integers(0, 10, batch).astype(np.int32), sharding)
+    # generate the (steps, batch, ...) input chunk on device: the tunnel
+    # carries no training data, only the dispatch + one scalar readback
+    data_sharding = NamedSharding(pg.mesh, P(None, pg.axis_name))
 
-    def chain(k):
-        # fresh state per chain: donated buffers cannot be reused
+    @jax.jit
+    def make_data(key):
+        kx, ky = jax.random.split(key)
+        xs = jax.random.normal(kx, (steps, batch, 28, 28, 1), jnp.float32)
+        ys = jax.random.randint(ky, (steps, batch), 0, 10, jnp.int32)
+        return (jax.lax.with_sharding_constraint(xs, data_sharding),
+                jax.lax.with_sharding_constraint(ys, data_sharding))
+
+    xs, ys = make_data(jax.random.key(0))
+    jax.block_until_ready(xs)
+
+    # long-minus-short differencing cancels the constant dispatch+readback
+    # overhead (~2 tunnel RTTs per measurement) that best/steps would
+    # otherwise book against the chip; the short-chunk slices are
+    # materialized outside the timed region so the copies don't bias it
+    n_short = max(1, min(steps - 1, steps // 5))
+    xs_short = jax.block_until_ready(xs[:n_short])
+    ys_short = ys[:n_short]
+
+    def run_chunk(cx, cy, k):
+        # fresh state per rep: donated buffers cannot be reused
         state = ddp.init(seed=0)
         t0 = time.perf_counter()
-        m = None
-        for _ in range(k):
-            state, m = ddp.train_step(state, x, y)
-        float(m["loss"])  # host readback = the only real sync on the tunnel
+        state, m = ddp.train_chunk(state, cx, cy)
+        float(m["loss"][-1])  # host readback = the only real sync on tunnel
         return time.perf_counter() - t0
 
-    chain(warmup)  # compile + warm
-    n_short = max(5, steps // 10)
-    d_short = min(chain(n_short) for _ in range(reps))
-    d_long = min(chain(steps + n_short) for _ in range(reps))
-    step_time = (d_long - d_short) / steps
+    for _ in range(warmup):  # compile both shapes + warm
+        run_chunk(xs, ys, steps)
+        run_chunk(xs_short, ys_short, n_short)
+    best_long = min(run_chunk(xs, ys, steps) for _ in range(reps))
+    best_short = min(run_chunk(xs_short, ys_short, n_short)
+                     for _ in range(reps))
+    step_time = (best_long - best_short) / (steps - n_short)
     images_per_sec_per_chip = batch / step_time / n_chips
 
     vs = 1.0

@@ -106,7 +106,6 @@ class TransformerLM(nn.Module):
         self.vocab_size = vocab_size
         self.max_seq_len = max_seq_len
         self.num_experts = num_experts
-        self.rope = rope
         self.tok = nn.Embedding(vocab_size, dim)
         self.pos = None if rope else nn.Embedding(max_seq_len, dim)
         for i in range(depth):

@@ -155,6 +155,7 @@ class DistributedDataParallel:
         if sync_batchnorm:
             convert_sync_batchnorm(module, self.axis)
         self._train_step = None
+        self._train_chunk = None
         self._eval_step = None
         self._forward = None
 
@@ -207,7 +208,19 @@ class DistributedDataParallel:
         return shardings
 
     # -- compiled steps --------------------------------------------------------
-    def _build_train_step(self, template: TrainState):
+    def _state_pspec(self, template: TrainState) -> TrainState:
+        """PartitionSpec pytree for TrainState: replicated, except ZeRO-1
+        opt_state sharded over the data axis (must agree with
+        :meth:`state_shardings`)."""
+        if self.shard_optimizer:
+            opt_spec = jax.tree.map(lambda l: _zero1_spec(l, self.axis),
+                                    template.opt_state)
+        else:
+            opt_spec = P()
+        return TrainState(params=P(), model_state=P(), opt_state=opt_spec,
+                          step=P(), rng=P())
+
+    def _make_local_step(self, template: TrainState):
         module, loss_fn, optimizer, axis = (self.module, self.loss_fn,
                                             self.optimizer, self.axis)
         has_state = module.has_state()
@@ -321,16 +334,28 @@ class DistributedDataParallel:
                                    rng_data)
             return new_state, {"loss": loss, "correct": correct}
 
-        mesh = self.group.mesh
-        if zero1:
-            opt_spec = jax.tree.map(lambda l: _zero1_spec(l, axis),
-                                    template.opt_state)
-        else:
-            opt_spec = P()
-        state_spec = TrainState(params=P(), model_state=P(),
-                                opt_state=opt_spec, step=P(), rng=P())
-        fn = jax.shard_map(local_step, mesh=mesh,
-                           in_specs=(state_spec, P(axis), P(axis)),
+        return local_step
+
+    def _build_train_step(self, template: TrainState):
+        state_spec = self._state_pspec(template)
+        fn = jax.shard_map(self._make_local_step(template),
+                           mesh=self.group.mesh,
+                           in_specs=(state_spec, P(self.axis), P(self.axis)),
+                           out_specs=(state_spec, P()))
+        return jax.jit(fn, donate_argnums=(0,) if self.donate else ())
+
+    def _build_train_chunk(self, template: TrainState):
+        local_step = self._make_local_step(template)
+
+        def local_chunk(state, xs, ys):
+            def body(st, xy):
+                return local_step(st, xy[0], xy[1])
+            return lax.scan(body, state, (xs, ys))
+
+        state_spec = self._state_pspec(template)
+        fn = jax.shard_map(local_chunk, mesh=self.group.mesh,
+                           in_specs=(state_spec, P(None, self.axis),
+                                     P(None, self.axis)),
                            out_specs=(state_spec, P()))
         return jax.jit(fn, donate_argnums=(0,) if self.donate else ())
 
@@ -362,6 +387,26 @@ class DistributedDataParallel:
         if self._train_step is None:
             self._train_step = self._build_train_step(state)
         return self._train_step(state, x, y)
+
+    def train_chunk(self, state: TrainState, xs, ys):
+        """Run ``xs.shape[0]`` fused train steps in ONE dispatch.
+
+        ``xs``/``ys`` carry a leading steps axis: ``xs[i]`` is step *i*'s
+        global batch (sharded over the data axis like ``train_step``'s).
+        The steps execute as a ``lax.scan`` on device — semantically
+        identical to ``k`` sequential :meth:`train_step` calls (tested),
+        but with a single host dispatch and readback.  This is the
+        TPU-idiomatic inner loop: host→device latency (or a slow tunnel)
+        stops mattering when k steps ride one XLA program.
+
+        Returns ``(new_state, metrics)`` where each metrics leaf is stacked
+        per-step, shape ``(k,)`` — log ``metrics["loss"][-1]`` or the mean.
+        """
+        if self.optimizer is None or self.loss_fn is None:
+            raise ValueError("train_chunk requires optimizer= and loss_fn=")
+        if self._train_chunk is None:
+            self._train_chunk = self._build_train_chunk(state)
+        return self._train_chunk(state, xs, ys)
 
     def eval_step(self, state: TrainState, x, y):
         if self.loss_fn is None:
