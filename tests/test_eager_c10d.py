@@ -96,3 +96,33 @@ def test_reduce_fn_table_matches_numpy():
     np.testing.assert_array_equal(_reduce_fn("band")(bits), [0b1000])
     np.testing.assert_array_equal(_reduce_fn("bor")(bits), [0b1110])
     np.testing.assert_array_equal(_reduce_fn("bxor")(bits), [0b0110])
+
+
+class TestObjectCollectives:
+    """Single-process semantics (multi-process paths run in
+    test_eager_c10d_e2e)."""
+
+    def test_all_gather_object_world1(self, pg):
+        obj = {"a": [1, 2], "b": "text"}
+        assert C.all_gather_object(obj, group=pg) == [obj]
+
+    def test_gather_object_world1(self, pg):
+        assert C.gather_object(("x", 3), dst=0, group=pg) == [("x", 3)]
+
+    def test_broadcast_object_list_world1(self, pg):
+        src = [{"k": 1}, None, "s"]
+        out = C.broadcast_object_list(src, src=0, group=pg)
+        assert out == src and out is not src  # functional copy, not alias
+
+    def test_scatter_object_list_world1(self, pg):
+        assert C.scatter_object_list([{"v": 9}], src=0, group=pg) == {"v": 9}
+
+    def test_scatter_object_list_wrong_len_raises(self, pg):
+        with pytest.raises(ValueError, match="num_processes"):
+            C.scatter_object_list([1, 2], src=0, group=pg)
+
+    def test_peer_range_checked(self, pg):
+        with pytest.raises(ValueError, match="out of range"):
+            C.gather_object(1, dst=5, group=pg)
+        with pytest.raises(ValueError, match="out of range"):
+            C.broadcast_object_list([1], src=-1, group=pg)

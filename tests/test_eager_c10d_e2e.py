@@ -62,6 +62,21 @@ _WORKER = textwrap.dedent("""
         C.send(a * 2, dst=0, group=pg)
         C.send(np.array([9, 9]), dst=0, group=pg, tag=7)
 
+    # object collectives: uneven pickled sizes per rank
+    obj = {"rank": r, "blob": "x" * (10 + 50 * r)}
+    ago = C.all_gather_object(obj, group=pg)
+    out["allgather_obj_ranks"] = [e["rank"] for e in ago]
+    out["allgather_obj_lens"] = [len(e["blob"]) for e in ago]
+    go = C.gather_object(("t", r), dst=1, group=pg)
+    out["gather_obj"] = None if go is None else [list(e) for e in go]
+    bol = C.broadcast_object_list(
+        [{"cfg": "lr0.02"}, ("tup", 1)] if r == 0 else [None, None],
+        src=0, group=pg)
+    out["bcast_obj"] = [bol[0]["cfg"], list(bol[1])]
+    mine = C.scatter_object_list(
+        [{"for": 0}, {"for": 1}] if r == 0 else None, src=0, group=pg)
+    out["scatter_obj"] = mine["for"]
+
     dist.barrier()
     with open(sys.argv[1] + f"/result{r}.json", "w") as f:
         json.dump(out, f)
@@ -106,3 +121,12 @@ def test_eager_c10d_two_processes(tmp_path):
     assert res[1]["got"] == [[0, 1, 2], [42.5]]
     assert res[0]["pong"] == [0, 2, 4]
     assert res[0]["tagged"] == [9, 9]
+
+    # object collectives (uneven payload sizes: 10 vs 60 chars)
+    for rank in res:
+        assert res[rank]["allgather_obj_ranks"] == [0, 1]
+        assert res[rank]["allgather_obj_lens"] == [10, 60]
+        assert res[rank]["bcast_obj"] == ["lr0.02", ["tup", 1]]
+        assert res[rank]["scatter_obj"] == rank
+    assert res[0]["gather_obj"] is None
+    assert res[1]["gather_obj"] == [["t", 0], ["t", 1]]

@@ -22,14 +22,16 @@ brought up through ``tpu_dist.launch`` (default) or with
 from __future__ import annotations
 
 import io
-from typing import List, Optional
+import pickle
+from typing import Any, List, Optional
 
 import jax
 import numpy as np
 
 __all__ = ["ReduceOp", "all_reduce_host", "all_gather_host",
            "broadcast_host", "reduce_host", "gather_host", "scatter_host",
-           "send", "recv"]
+           "send", "recv", "all_gather_object", "gather_object",
+           "broadcast_object_list", "scatter_object_list"]
 
 
 class ReduceOp:
@@ -181,6 +183,103 @@ def scatter_host(output_template, scatter_list: Optional[List] = None,
     full = multihost_utils.broadcast_one_to_all(
         payload, is_source=group.rank == src)
     return jax.tree.map(np.asarray, full[group.rank])
+
+
+# -- object collectives (pickle wire format, torch parity) --------------------
+#
+# torch's *_object collectives pickle arbitrary Python objects onto the
+# tensor transport; same here, onto the uint8 array transport.  Same trust
+# model as torch: never unpickle across a trust boundary — the group is
+# assumed to be one job.  Payload sizes may differ per process, so each
+# collective first agrees on the max length, pads, then truncates per rank.
+
+
+def _obj_to_u8(obj: Any) -> np.ndarray:
+    return np.frombuffer(pickle.dumps(obj), np.uint8)
+
+
+def _all_gather_u8(obj: Any, group) -> tuple:
+    """Pickle + pad + all-gather; returns ``(rows, lens)`` with ``rows[r]``
+    the padded uint8 payload of rank ``r`` and ``lens[r]`` its true size."""
+    payload = _obj_to_u8(obj)
+    lens = all_gather_host(np.int64(payload.size), group)
+    padded = np.zeros(int(lens.max()), np.uint8)
+    padded[:payload.size] = payload
+    return all_gather_host(padded, group), lens
+
+
+def all_gather_object(obj: Any, group=None) -> List[Any]:
+    """torch ``dist.all_gather_object`` parity: every process returns the
+    list of all processes' objects (index = rank)."""
+    group = _default_group(group)
+    if group.num_processes <= 1:
+        return [obj]
+    rows, lens = _all_gather_u8(obj, group)
+    return [pickle.loads(rows[r, :int(lens[r])].tobytes())
+            for r in range(group.num_processes)]
+
+
+def gather_object(obj: Any, dst: int = 0, group=None) -> Optional[List[Any]]:
+    """torch ``dist.gather_object`` parity: process ``dst`` returns the
+    rank-indexed object list; every other process returns ``None``."""
+    group = _default_group(group)
+    _check_peer(dst, group, "dst")
+    if group.num_processes <= 1:
+        return [obj] if group.rank == dst else None
+    # the gather itself is collective (every rank participates in the
+    # underlying all-gather), but only dst pays the unpickling
+    rows, lens = _all_gather_u8(obj, group)
+    if group.rank != dst:
+        return None
+    return [pickle.loads(rows[r, :int(lens[r])].tobytes())
+            for r in range(group.num_processes)]
+
+
+def broadcast_object_list(object_list: List[Any], src: int = 0,
+                          group=None) -> List[Any]:
+    """torch ``dist.broadcast_object_list`` parity, functional form: returns
+    process ``src``'s list on every process (same length; torch mutates the
+    preallocated list in place instead of returning)."""
+    group = _default_group(group)
+    _check_peer(src, group, "src")
+    if group.num_processes <= 1:
+        return list(object_list)
+    from jax.experimental import multihost_utils
+    is_src = group.rank == src
+    payload = _obj_to_u8(list(object_list)) if is_src else np.zeros(0, np.uint8)
+    # non-src processes don't know the size: agree on it first
+    size = int(multihost_utils.broadcast_one_to_all(
+        np.int64(payload.size), is_source=is_src))
+    buf = np.zeros(size, np.uint8)
+    buf[:payload.size] = payload
+    out = multihost_utils.broadcast_one_to_all(buf, is_source=is_src)
+    return pickle.loads(np.asarray(out).tobytes())
+
+
+def scatter_object_list(scatter_object_input_list: Optional[List[Any]] = None,
+                        src: int = 0, group=None) -> Any:
+    """torch ``dist.scatter_object_list`` parity, functional form: process
+    ``src`` supplies one object per process; every process returns its own
+    (torch writes it into a 1-element output list instead)."""
+    group = _default_group(group)
+    n = group.num_processes
+    _check_peer(src, group, "src")
+    if group.rank == src:
+        if (scatter_object_input_list is None
+                or len(scatter_object_input_list) != n):
+            got = (None if scatter_object_input_list is None
+                   else len(scatter_object_input_list))
+            raise ValueError(
+                f"scatter src must pass scatter_object_input_list with "
+                f"num_processes={n} entries, got {got}")
+        if n <= 1:
+            return scatter_object_input_list[0]
+    # one broadcast of the full list, then local pick (same trade-off as
+    # scatter_host; an O(1)-per-rank path would ride the store)
+    full = broadcast_object_list(
+        scatter_object_input_list if group.rank == src else [None] * n,
+        src=src, group=group)
+    return full[group.rank]
 
 
 # -- point-to-point over the control-plane store ------------------------------

@@ -119,7 +119,8 @@ class DistributedDataParallel:
     def __init__(self, module: Module, optimizer=None, loss_fn=None,
                  group=None, sync_batchnorm: bool = False,
                  donate: bool = True, compute_dtype=None,
-                 accum_steps: int = 1, shard_optimizer: bool = False):
+                 accum_steps: int = 1, shard_optimizer: bool = False,
+                 comm_dtype=None):
         """Options beyond torch-DDP parity (all default off):
 
         ``compute_dtype``: run forward/backward in this dtype (bf16 for the
@@ -137,6 +138,16 @@ class DistributedDataParallel:
         an optimizer update that each replica performs on only 1/world of
         the (flattened) parameters, so optimizer state is sharded 1/world
         per device.  Numerics identical to the dense path (tested).
+
+        ``comm_dtype``: compress the gradient all-reduce to this dtype
+        (torch DDP *comm hook* parity — ``fp16_compress_hook`` /
+        ``bf16_compress_hook``): local grads are divided by world size,
+        cast to ``comm_dtype`` for the wire (pre-division keeps the fp16
+        sum under 65504 at any world size, as the torch hook does), summed,
+        and cast back to the gradient's dtype before the optimizer update.
+        Halves ICI/DCN bytes per step with 16-bit dtypes; composes with
+        ``accum_steps`` (compression happens once, at sync time, like the
+        torch hook) and ZeRO-1 (the reduce-scatter runs compressed).
         """
         if group is None:
             from .. import dist as _dist
@@ -152,6 +163,7 @@ class DistributedDataParallel:
         self.compute_dtype = compute_dtype
         self.accum_steps = accum_steps
         self.shard_optimizer = shard_optimizer
+        self.comm_dtype = comm_dtype
         if sync_batchnorm:
             convert_sync_batchnorm(module, self.axis)
         self._train_step = None
@@ -226,6 +238,7 @@ class DistributedDataParallel:
         has_state = module.has_state()
         accum = self.accum_steps
         cdtype = self.compute_dtype
+        comm_dtype = self.comm_dtype
         zero1 = self.shard_optimizer
         n = self.group.size()
 
@@ -296,14 +309,25 @@ class DistributedDataParallel:
                 loss = lax.pmean(loss_sum, axis)
                 correct = lax.psum(correct_sum, axis)
 
+            # comm-hook compression (torch DDP fp16/bf16_compress_hook
+            # semantics): divide by world size BEFORE the cast so the
+            # compressed-dtype sum cannot overflow (fp16 max 65504), move
+            # comm_dtype bytes on the wire, and decompress to the original
+            # grad dtype after the reduce — accumulation and the optimizer
+            # update stay in the uncompressed dtype
             if zero1:
                 # reduce-scatter averaged grads; update 1/n of the flat
                 # parameter vector per device; all-gather updated params
                 flat_g = _flatten_params(local_grads)
                 padded = _ceil_to(flat_g.size, n)
                 flat_g = jnp.pad(flat_g, (0, padded - flat_g.size))
-                g_shard = lax.psum_scatter(flat_g, axis, scatter_dimension=0,
-                                           tiled=True) / n
+                if comm_dtype is None:
+                    g_shard = lax.psum_scatter(
+                        flat_g, axis, scatter_dimension=0, tiled=True) / n
+                else:
+                    g_shard = lax.psum_scatter(
+                        (flat_g / n).astype(comm_dtype), axis,
+                        scatter_dimension=0, tiled=True).astype(flat_g.dtype)
                 flat_p = _flatten_params(params)
                 flat_p = jnp.pad(flat_p, (0, padded - flat_p.size))
                 chunk = padded // n
@@ -321,8 +345,16 @@ class DistributedDataParallel:
                 flat_new = lax.psum(contrib, axis)
                 new_params = _unflatten_params(flat_new, params)
             else:
-                grads = jax.tree.map(lambda g: lax.pmean(g, axis),
-                                     local_grads)
+                if comm_dtype is None:
+                    grads = jax.tree.map(lambda g: lax.pmean(g, axis),
+                                         local_grads)
+                else:
+                    grads = jax.tree.map(
+                        lambda g: lax.psum((g / n).astype(comm_dtype),
+                                           axis).astype(g.dtype)
+                        if jnp.issubdtype(g.dtype, jnp.floating) else
+                        lax.pmean(g, axis),
+                        local_grads)
                 new_params, new_opt = optimizer.update(grads, opt_state,
                                                        params)
 
