@@ -12,4 +12,4 @@ readback as the only sync (block_until_ready does not wait on the axon
 tunnel), best-of-k (long - short) marginal step time.
 """
 
-from .timing import chained_step_time  # noqa: F401
+from .timing import chained_step_time, ddp_repeat_step_time  # noqa: F401

@@ -57,7 +57,7 @@ def device_step_images_per_sec(batch: int = 128,
     from tpu_dist import nn, optim
     from tpu_dist.models import resnet50
     from tpu_dist.parallel import DistributedDataParallel
-    from .timing import chained_step_time
+    from .timing import ddp_repeat_step_time
 
     own_group = not dist.is_initialized()
     pg = dist.init_process_group() if own_group else dist.get_default_group()
@@ -75,11 +75,7 @@ def device_step_images_per_sec(batch: int = 128,
     y = jax.device_put(rng.integers(0, 1000, batch * n_chips).astype(np.int32),
                        sharding)
 
-    def step(state):
-        new_state, m = ddp.train_step(state, x, y)
-        return new_state, m["loss"]
-
-    t = chained_step_time(step, lambda: ddp.init(seed=0), steps=20, reps=2)
+    t = ddp_repeat_step_time(ddp, x, y, steps=20, reps=3)
     if own_group:
         dist.destroy_process_group()
     return batch * n_chips / t

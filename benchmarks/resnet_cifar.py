@@ -25,7 +25,7 @@ def run(per_chip_batch: int = 256, steps: int = 50, reps: int = 3) -> dict:
     from tpu_dist.models import resnet18
     from tpu_dist.parallel import DistributedDataParallel
 
-    from .timing import chained_step_time
+    from .timing import ddp_repeat_step_time
 
     own_group = not dist.is_initialized()
     pg = dist.init_process_group() if own_group else dist.get_default_group()
@@ -45,12 +45,7 @@ def run(per_chip_batch: int = 256, steps: int = 50, reps: int = 3) -> dict:
                        sharding)
     y = jax.device_put(rng.integers(0, 10, batch).astype(np.int32), sharding)
 
-    def step(state):
-        new_state, m = ddp.train_step(state, x, y)
-        return new_state, m["loss"]
-
-    t = chained_step_time(step, lambda: ddp.init(seed=0),
-                          steps=steps, reps=reps)
+    t = ddp_repeat_step_time(ddp, x, y, steps=steps, reps=reps)
     result = {
         "metric": "resnet18_cifar10_bf16_train_images_per_sec_per_chip",
         "value": round(batch / t / n_chips, 1),

@@ -42,7 +42,7 @@ def _measure(per_device_batch: int = 128, steps: int = 30,
     from tpu_dist import nn, optim
     from tpu_dist.models import ConvNet
     from tpu_dist.parallel import DistributedDataParallel
-    from benchmarks.timing import chained_step_time
+    from benchmarks.timing import ddp_repeat_step_time
 
     dist.init_process_group(backend="cpu")
     rng = np.random.default_rng(0)
@@ -59,12 +59,7 @@ def _measure(per_device_batch: int = 128, steps: int = 30,
         y = jax.device_put(rng.integers(0, 10, batch).astype(np.int32),
                            sharding)
 
-        def step(state, ddp=ddp, x=x, y=y):
-            new_state, m = ddp.train_step(state, x, y)
-            return new_state, m["loss"]
-
-        times[n] = chained_step_time(step, lambda ddp=ddp: ddp.init(seed=0),
-                                     steps=steps, reps=reps)
+        times[n] = ddp_repeat_step_time(ddp, x, y, steps=steps, reps=reps)
     dist.destroy_process_group()
 
     t1 = times[1]

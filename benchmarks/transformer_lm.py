@@ -29,7 +29,7 @@ def run(batch: int = 8, seq_len: int = 2048, dim: int = 768,
     from tpu_dist.models import TransformerLM
     from tpu_dist.parallel import DistributedDataParallel
 
-    from .timing import chained_step_time
+    from .timing import ddp_repeat_step_time
 
     own_group = not dist.is_initialized()
     pg = dist.init_process_group() if own_group else dist.get_default_group()
@@ -49,12 +49,7 @@ def run(batch: int = 8, seq_len: int = 2048, dim: int = 768,
     y = jax.device_put(
         rng.integers(0, vocab, (batch * n_chips, seq_len)), shard)
 
-    def step(state):
-        new_state, metrics = ddp.train_step(state, x, y)
-        return new_state, metrics["loss"]
-
-    sec = chained_step_time(step, lambda: ddp.init(seed=0), steps=steps,
-                            reps=reps)
+    sec = ddp_repeat_step_time(ddp, x, y, steps=steps, reps=reps)
     tokens_per_step = batch * seq_len                   # per chip
     tok_s = tokens_per_step / sec
 

@@ -45,6 +45,8 @@ def main():
     parser.add_argument("--max-steps", default=0, type=int)
     parser.add_argument("--bf16", action="store_true",
                         help="bfloat16 compute (BASELINE.md ladder #4)")
+    parser.add_argument("--evaluate", action="store_true",
+                        help="run test-set evaluation after training")
     args = parser.parse_args()
 
     if args.backend == "cpu":
@@ -129,6 +131,25 @@ def main():
             break
     if rank == 0:
         print("Training complete in: " + str(datetime.now() - start))
+
+    if args.evaluate:
+        test_ds = CIFAR10(
+            root=args.data_root, train=False,
+            transform=transforms.Normalize(transforms.CIFAR10_MEAN,
+                                           transforms.CIFAR10_STD),
+            synthetic_fallback=args.synthetic or None)
+        # every process stages the SAME sequential global batches (the
+        # DeviceLoader shards each over the mesh), so evaluation covers the
+        # test set exactly once: no DistributedSampler padding duplicates,
+        # exact count; ddp.evaluate pads the final partial batch
+        test_loader = DeviceLoader(
+            DataLoader(test_ds, batch_size=world_batch, drop_last=False,
+                       num_workers=4, pin_memory=True),
+            group=pg)
+        res = ddp.evaluate(state, test_loader)
+        if rank == 0:
+            print("Test: loss {:.3f}, acc {:.3f} ({} samples)".format(
+                res["loss"], res["accuracy"], res["count"]))
     dist.destroy_process_group()
 
 

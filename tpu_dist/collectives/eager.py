@@ -244,15 +244,13 @@ def broadcast_object_list(object_list: List[Any], src: int = 0,
     _check_peer(src, group, "src")
     if group.num_processes <= 1:
         return list(object_list)
-    from jax.experimental import multihost_utils
     is_src = group.rank == src
     payload = _obj_to_u8(list(object_list)) if is_src else np.zeros(0, np.uint8)
     # non-src processes don't know the size: agree on it first
-    size = int(multihost_utils.broadcast_one_to_all(
-        np.int64(payload.size), is_source=is_src))
+    size = int(broadcast_host(np.int64(payload.size), group, src=src))
     buf = np.zeros(size, np.uint8)
     buf[:payload.size] = payload
-    out = multihost_utils.broadcast_one_to_all(buf, is_source=is_src)
+    out = broadcast_host(buf, group, src=src)
     return pickle.loads(np.asarray(out).tobytes())
 
 

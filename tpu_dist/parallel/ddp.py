@@ -168,6 +168,7 @@ class DistributedDataParallel:
             convert_sync_batchnorm(module, self.axis)
         self._train_step = None
         self._train_chunk = None
+        self._train_repeat_cache = {}
         self._eval_step = None
         self._forward = None
 
@@ -391,6 +392,20 @@ class DistributedDataParallel:
                            out_specs=(state_spec, P()))
         return jax.jit(fn, donate_argnums=(0,) if self.donate else ())
 
+    def _build_train_repeat(self, template: TrainState, num_steps: int):
+        local_step = self._make_local_step(template)
+
+        def local_repeat(state, x, y):
+            def body(st, _):
+                return local_step(st, x, y)
+            return lax.scan(body, state, None, length=num_steps)
+
+        state_spec = self._state_pspec(template)
+        fn = jax.shard_map(local_repeat, mesh=self.group.mesh,
+                           in_specs=(state_spec, P(self.axis), P(self.axis)),
+                           out_specs=(state_spec, P()))
+        return jax.jit(fn, donate_argnums=(0,) if self.donate else ())
+
     def _build_eval_step(self):
         module, loss_fn, axis = self.module, self.loss_fn, self.axis
         has_state = module.has_state()
@@ -440,12 +455,71 @@ class DistributedDataParallel:
             self._train_chunk = self._build_train_chunk(state)
         return self._train_chunk(state, xs, ys)
 
+    def train_repeat(self, state: TrainState, x, y, num_steps: int):
+        """``num_steps`` fused steps on the SAME batch in one dispatch.
+
+        Like :meth:`train_chunk` but the batch is scan-invariant, so no
+        ``(k, batch, ...)`` input is materialized — the per-step rng still
+        advances (the step counter seeds dropout keys).  Uses: throughput
+        measurement (benchmarks/timing.py) and overfit-one-batch debugging.
+        Returns ``(new_state, metrics)`` with per-step ``(k,)`` leaves.
+        """
+        if self.optimizer is None or self.loss_fn is None:
+            raise ValueError("train_repeat requires optimizer= and loss_fn=")
+        fn = self._train_repeat_cache.get(num_steps)
+        if fn is None:
+            fn = self._build_train_repeat(state, num_steps)
+            self._train_repeat_cache[num_steps] = fn
+        return fn(state, x, y)
+
     def eval_step(self, state: TrainState, x, y):
         if self.loss_fn is None:
             raise ValueError("eval_step requires loss_fn=")
         if self._eval_step is None:
             self._eval_step = self._build_eval_step()
         return self._eval_step(state, x, y)
+
+    def evaluate(self, state: TrainState, loader) -> dict:
+        """Drive :meth:`eval_step` over a loader of ``(x, y)`` batches;
+        returns global ``{"loss", "accuracy", "count"}`` (sample-weighted —
+        the torch eval-loop idiom; metrics are identical on every process
+        since ``eval_step`` reduces over the whole mesh).
+
+        A final partial batch is padded up to the first batch's size with
+        ``ignore_index`` labels (one compiled shape, and the global batch
+        stays divisible over the mesh): the loss reduction skips ignored
+        rows, and a padded row can never count as correct (argmax is in
+        [0, C)), so ``accuracy`` and ``count`` are exact.  The padded
+        batch's loss contribution uses per-device means (the torch
+        distributed-eval idiom), a negligible skew on one batch.  Metrics
+        accumulate on device; the single host readback happens at the end
+        (per-step ``float()`` would serialize eval over the dispatch
+        latency).
+        """
+        ignore = getattr(self.loss_fn, "ignore_index", -100)
+        pad_to = None
+        total_loss = total_correct = None
+        n = 0
+        for x, y in loader:
+            b = int(x.shape[0])
+            if pad_to is None:
+                pad_to = b
+            if b < pad_to:
+                x = jnp.concatenate(
+                    [x, jnp.zeros((pad_to - b,) + x.shape[1:], x.dtype)])
+                y = jnp.concatenate(
+                    [y, jnp.full((pad_to - b,), ignore, y.dtype)])
+            m = self.eval_step(state, x, y)
+            loss_term = m["loss"] * b
+            total_loss = (loss_term if total_loss is None
+                          else total_loss + loss_term)
+            total_correct = (m["correct"] if total_correct is None
+                             else total_correct + m["correct"])
+            n += b
+        if n == 0:
+            return {"loss": 0.0, "accuracy": 0.0, "count": 0}
+        return {"loss": float(total_loss) / n,
+                "accuracy": int(total_correct) / n, "count": n}
 
     def forward(self, state: TrainState, x):
         """Inference forward on a (data-axis-sharded) batch; returns logits
