@@ -292,3 +292,37 @@ class TestEvaluate:
         assert res2["count"] == 168
         exact = ddp.evaluate(st, [(xj[:168], yj[:168])])
         assert abs(res2["accuracy"] - exact["accuracy"]) < 1e-9
+
+
+class TestEvaluateEdgeCases:
+    def test_single_short_batch_padded_to_mesh(self, pg):
+        """A lone batch not divisible by the device count is padded up
+        (regression: first-batch divisibility)."""
+        n_dev = pg.size()
+        b = n_dev + 1 if n_dev > 1 else 3
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(b, 28, 28, 1)).astype(np.float32))
+        y = jnp.asarray(rng.integers(0, 10, b).astype(np.int32))
+        ddp = _mk(pg)
+        res = ddp.evaluate(ddp.init(seed=0), [(x, y)])
+        assert res["count"] == b
+
+    def test_sequence_labels(self, pg):
+        """(batch, seq) labels: accuracy is per token, padding is
+        rank-aware (regression: seq-model evaluate)."""
+        from tpu_dist.models import TransformerLM
+        from tpu_dist.parallel import DDP
+        model = TransformerLM(vocab_size=17, dim=16, depth=1, num_heads=2,
+                              max_seq_len=8)
+        ddp = DDP(model, optimizer=optim.SGD(lr=0.1),
+                  loss_fn=nn.CrossEntropyLoss(), group=pg, donate=False)
+        st = ddp.init(seed=0)
+        rng = np.random.default_rng(0)
+        n_dev = pg.size()
+        full, part = 2 * n_dev, n_dev + 1 if n_dev > 1 else 3
+        xs = jnp.asarray(rng.integers(0, 17, (full + part, 8)))
+        ys = jnp.asarray(rng.integers(0, 17, (full + part, 8)))
+        res = ddp.evaluate(st, [(xs[:full], ys[:full]),
+                                (xs[full:], ys[full:])])
+        assert res["count"] == (full + part) * 8  # tokens, not rows
+        assert 0.0 <= res["accuracy"] <= 1.0

@@ -126,3 +126,12 @@ class TestObjectCollectives:
             C.gather_object(1, dst=5, group=pg)
         with pytest.raises(ValueError, match="out of range"):
             C.broadcast_object_list([1], src=-1, group=pg)
+
+
+class TestAllToAllHost:
+    def test_world1_identity(self, pg):
+        assert C.all_to_all_host([{"x": 1}], group=pg) == [{"x": 1}]
+
+    def test_wrong_len_raises(self, pg):
+        with pytest.raises(ValueError, match="one entry per process"):
+            C.all_to_all_host([1, 2], group=pg)

@@ -76,6 +76,9 @@ _WORKER = textwrap.dedent("""
     mine = C.scatter_object_list(
         [{"for": 0}, {"for": 1}] if r == 0 else None, src=0, group=pg)
     out["scatter_obj"] = mine["for"]
+    # all_to_all: rank r sends (r, q) to rank q; receives [(0, r), (1, r)]
+    a2a = C.all_to_all_host([(r, q) for q in range(2)], group=pg)
+    out["a2a"] = [list(e) for e in a2a]
 
     dist.barrier()
     with open(sys.argv[1] + f"/result{r}.json", "w") as f:
@@ -130,3 +133,5 @@ def test_eager_c10d_two_processes(tmp_path):
         assert res[rank]["scatter_obj"] == rank
     assert res[0]["gather_obj"] is None
     assert res[1]["gather_obj"] == [["t", 0], ["t", 1]]
+    for rank in res:
+        assert res[rank]["a2a"] == [[0, rank], [1, rank]]

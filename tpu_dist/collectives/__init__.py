@@ -22,9 +22,9 @@ as a building block for later pipeline/sequence parallelism.
 from .ops import (all_gather, all_reduce, all_to_all, broadcast, pmean,
                   ppermute, psum, reduce_scatter, ring_all_reduce)
 from .eager import (ReduceOp, all_gather_host, all_gather_object,
-                    all_reduce_host, broadcast_host, broadcast_object_list,
-                    gather_host, gather_object, recv, reduce_host,
-                    scatter_host, scatter_object_list, send)
+                    all_reduce_host, all_to_all_host, broadcast_host,
+                    broadcast_object_list, gather_host, gather_object, recv,
+                    reduce_host, scatter_host, scatter_object_list, send)
 
 __all__ = [
     "all_reduce", "all_gather", "reduce_scatter", "broadcast", "all_to_all",
@@ -32,5 +32,5 @@ __all__ = [
     "ReduceOp", "all_reduce_host", "all_gather_host", "broadcast_host",
     "reduce_host", "gather_host", "scatter_host", "send", "recv",
     "all_gather_object", "gather_object", "broadcast_object_list",
-    "scatter_object_list",
+    "scatter_object_list", "all_to_all_host",
 ]

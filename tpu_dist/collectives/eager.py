@@ -31,7 +31,7 @@ import numpy as np
 __all__ = ["ReduceOp", "all_reduce_host", "all_gather_host",
            "broadcast_host", "reduce_host", "gather_host", "scatter_host",
            "send", "recv", "all_gather_object", "gather_object",
-           "broadcast_object_list", "scatter_object_list"]
+           "broadcast_object_list", "scatter_object_list", "all_to_all_host"]
 
 
 class ReduceOp:
@@ -278,6 +278,25 @@ def scatter_object_list(scatter_object_input_list: Optional[List[Any]] = None,
         scatter_object_input_list if group.rank == src else [None] * n,
         src=src, group=group)
     return full[group.rank]
+
+
+def all_to_all_host(input_list: List[Any], group=None) -> List[Any]:
+    """torch ``dist.all_to_all`` parity: process *p* sends
+    ``input_list[q]`` to process *q*; returns the received list, entry *r*
+    = what rank *r* addressed to this process.  Rides the object transport,
+    so entries may be arrays of any (per-pair) shape or arbitrary objects;
+    like :func:`scatter_host`, the full exchange is one all-gather — fine
+    for control-plane traffic, not for hot-path tensor redistribution
+    (that's the in-jit :func:`tpu_dist.collectives.all_to_all`)."""
+    group = _default_group(group)
+    n = group.num_processes
+    if len(input_list) != n:
+        raise ValueError(f"all_to_all needs one entry per process "
+                         f"(num_processes={n}), got {len(input_list)}")
+    if n <= 1:
+        return list(input_list)
+    rows = all_gather_object(list(input_list), group)
+    return [rows[r][group.rank] for r in range(n)]
 
 
 # -- point-to-point over the control-plane store ------------------------------

@@ -38,6 +38,7 @@ from typing import Any, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -410,10 +411,12 @@ class DistributedDataParallel:
         module, loss_fn, axis = self.module, self.loss_fn, self.axis
         has_state = module.has_state()
 
-        def local_eval(state: TrainState, x, y):
-            out = module.apply(state.params, x,
-                               **({"state": state.model_state} if has_state
-                                  else {}))
+        # takes only (params, model_state): feeding the whole TrainState
+        # would re-lay-out ZeRO-1-sharded opt_state to replicated (an
+        # all-gather of optimizer moments) on every eval batch
+        def local_eval(params, mstate, x, y):
+            out = module.apply(params, x,
+                               **({"state": mstate} if has_state else {}))
             if has_state:
                 out, _ = out
             loss = lax.pmean(loss_fn(out, y), axis)
@@ -421,7 +424,7 @@ class DistributedDataParallel:
             return {"loss": loss, "correct": correct}
 
         fn = jax.shard_map(local_eval, mesh=self.group.mesh,
-                           in_specs=(P(), P(axis), P(axis)),
+                           in_specs=(P(), P(), P(axis), P(axis)),
                            out_specs=P())
         return jax.jit(fn)
 
@@ -477,7 +480,7 @@ class DistributedDataParallel:
             raise ValueError("eval_step requires loss_fn=")
         if self._eval_step is None:
             self._eval_step = self._build_eval_step()
-        return self._eval_step(state, x, y)
+        return self._eval_step(state.params, state.model_state, x, y)
 
     def evaluate(self, state: TrainState, loader) -> dict:
         """Drive :meth:`eval_step` over a loader of ``(x, y)`` batches;
@@ -485,37 +488,42 @@ class DistributedDataParallel:
         the torch eval-loop idiom; metrics are identical on every process
         since ``eval_step`` reduces over the whole mesh).
 
-        A final partial batch is padded up to the first batch's size with
-        ``ignore_index`` labels (one compiled shape, and the global batch
-        stays divisible over the mesh): the loss reduction skips ignored
-        rows, and a padded row can never count as correct (argmax is in
-        [0, C)), so ``accuracy`` and ``count`` are exact.  The padded
-        batch's loss contribution uses per-device means (the torch
-        distributed-eval idiom), a negligible skew on one batch.  Metrics
-        accumulate on device; the single host readback happens at the end
-        (per-step ``float()`` would serialize eval over the dispatch
-        latency).
+        Partial batches are padded with ``ignore_index`` labels up to the
+        first batch's size rounded to a multiple of the mesh's device count
+        (one compiled shape, always divisible over the data axis): the loss
+        reduction skips ignored labels, and a padded label can never count
+        as correct (argmax is in [0, C)), so ``accuracy`` and ``count``
+        stay exact.  ``count`` is the number of *labels* scored — samples
+        for classification, tokens for sequence models with ``(batch,
+        seq)``-shaped labels.  A padded batch's loss contribution uses
+        per-device means (the torch distributed-eval idiom), a negligible
+        skew on that one batch.  Metrics accumulate on device; the single
+        host readback happens at the end (per-step ``float()`` would
+        serialize eval over the dispatch latency).
         """
         ignore = getattr(self.loss_fn, "ignore_index", -100)
+        n_dev = self.group.size()
         pad_to = None
         total_loss = total_correct = None
         n = 0
         for x, y in loader:
             b = int(x.shape[0])
-            if pad_to is None:
-                pad_to = b
+            target = _ceil_to(b, n_dev)
+            pad_to = target if pad_to is None else max(pad_to, target)
             if b < pad_to:
                 x = jnp.concatenate(
                     [x, jnp.zeros((pad_to - b,) + x.shape[1:], x.dtype)])
                 y = jnp.concatenate(
-                    [y, jnp.full((pad_to - b,), ignore, y.dtype)])
+                    [y, jnp.full((pad_to - b,) + y.shape[1:], ignore,
+                                 y.dtype)])
             m = self.eval_step(state, x, y)
-            loss_term = m["loss"] * b
+            labels = b * int(np.prod(y.shape[1:], dtype=np.int64))
+            loss_term = m["loss"] * labels
             total_loss = (loss_term if total_loss is None
                           else total_loss + loss_term)
             total_correct = (m["correct"] if total_correct is None
                              else total_correct + m["correct"])
-            n += b
+            n += labels
         if n == 0:
             return {"loss": 0.0, "accuracy": 0.0, "count": 0}
         return {"loss": float(total_loss) / n,
