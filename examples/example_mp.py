@@ -145,7 +145,7 @@ def main():
         test_loader = DeviceLoader(
             DataLoader(test_ds, batch_size=world_batch, drop_last=False,
                        num_workers=4, pin_memory=True),
-            group=pg)
+            group=pg, local_shards=False)
         res = ddp.evaluate(state, test_loader)
         if rank == 0:
             print("Test: loss {:.3f}, acc {:.3f} ({} samples)".format(

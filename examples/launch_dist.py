@@ -36,6 +36,8 @@ def main():
                              "contract (--pass_local_rank); env LOCAL_RANK "
                              "is authoritative")
     parser.add_argument("--max-steps", default=0, type=int)
+    parser.add_argument("--evaluate", action="store_true",
+                        help="run test-set evaluation after training")
     args = parser.parse_args()
 
     if args.backend == "cpu":
@@ -93,6 +95,22 @@ def main():
             break
     if rank == 0:
         print("Training complete in: " + str(datetime.now() - start))
+
+    if args.evaluate:
+        test_ds = MNIST(root=args.data_root, train=False,
+                        transform=transforms.Normalize(
+                            transforms.MNIST_MEAN, transforms.MNIST_STD),
+                        synthetic_fallback=args.synthetic or None)
+        # sequential full-set global batches on every process: exact
+        # count, no sampler padding duplicates (see examples/example_mp.py)
+        test_loader = DeviceLoader(
+            DataLoader(test_ds, batch_size=world_batch, drop_last=False,
+                       num_workers=2),
+            group=pg, local_shards=False)
+        res = ddp.evaluate(state, test_loader)
+        if rank == 0:
+            print("Test: loss {:.3f}, acc {:.3f} ({} samples)".format(
+                res["loss"], res["accuracy"], res["count"]))
     dist.destroy_process_group()
 
 

@@ -90,6 +90,22 @@ def train(args):
             break
     if rank == 0:
         print("Training complete in: " + str(datetime.now() - start))
+
+    if getattr(args, "evaluate", False):
+        test_ds = MNIST(root=args.data_root, train=False,
+                        transform=transforms.Normalize(
+                            transforms.MNIST_MEAN, transforms.MNIST_STD),
+                        synthetic_fallback=args.synthetic or None)
+        # sequential full-set global batches on every process: exact
+        # count, no sampler padding duplicates (see examples/example_mp.py)
+        test_loader = DeviceLoader(
+            DataLoader(test_ds, batch_size=global_batch, drop_last=False,
+                       num_workers=2),
+            group=pg, local_shards=False)
+        res = ddp.evaluate(state, test_loader)
+        if rank == 0:
+            print("Test: loss {:.3f}, acc {:.3f} ({} samples)".format(
+                res["loss"], res["accuracy"], res["count"]))
     dist.destroy_process_group()
 
 
@@ -124,6 +140,8 @@ def main():
     parser.add_argument("--synthetic", action="store_true",
                         help="use the deterministic synthetic MNIST")
     parser.add_argument("--max-steps", default=0, type=int)
+    parser.add_argument("--evaluate", action="store_true",
+                        help="run test-set evaluation after training")
     parser.add_argument("--ref-logs", action="store_true",
                         help="emit the reference's exact breadcrumb strings")
     args = parser.parse_args()

@@ -326,3 +326,27 @@ class TestEvaluateEdgeCases:
                                 (xs[full:], ys[full:])])
         assert res["count"] == (full + part) * 8  # tokens, not rows
         assert 0.0 <= res["accuracy"] <= 1.0
+
+
+class TestEvaluateIgnoreTokens:
+    def test_data_inherent_ignore_tokens_excluded(self, pg):
+        """Targets carrying real ignore_index padding (variable-length
+        sequences): count and accuracy cover only scored tokens."""
+        from tpu_dist.models import TransformerLM
+        model = TransformerLM(vocab_size=17, dim=16, depth=1, num_heads=2,
+                              max_seq_len=8)
+        ddp = DDP(model, optimizer=optim.SGD(lr=0.1),
+                  loss_fn=nn.CrossEntropyLoss(), group=pg, donate=False)
+        st = ddp.init(seed=0)
+        rng = np.random.default_rng(0)
+        B = 2 * pg.size()
+        xs = jnp.asarray(rng.integers(0, 17, (B, 8)))
+        ys_np = rng.integers(0, 17, (B, 8))
+        ys_np[:, 5:] = -100  # last 3 tokens of every row are padding
+        ys = jnp.asarray(ys_np)
+        res = ddp.evaluate(st, [(xs, ys)])
+        assert res["count"] == B * 5  # only scored tokens
+        # exact agreement with manual accuracy on the scored region
+        logits = model.apply(st.params, xs)
+        manual = float((jnp.argmax(logits[:, :5], -1) == ys[:, :5]).mean())
+        assert abs(res["accuracy"] - manual) < 1e-6

@@ -80,3 +80,24 @@ def test_env_rendezvous_two_processes(tmp_path, nproc):
         assert res["allreduce_sum"] == 3.0  # 1 + 2
         assert res["gathered"] == [0, 1]
         assert res["broadcast"] == 10.0  # src=1's value
+
+
+def test_two_process_training_and_eval(tmp_path):
+    """2-process DDP training through the full data path (DistributedSampler
+    → DataLoader → DeviceLoader.make_array_from_process_local_data) plus
+    sequential full-set evaluation (local_shards=False).  Regression: plain
+    device_put asserts cross-process equality, so per-process shards used
+    to crash the very first training batch."""
+    import os
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_dist.launch", "--nproc_per_node=2",
+         "--master_port=0", "examples/launch_dist.py", "--backend", "cpu",
+         "--synthetic", "--max-steps", "2", "--epochs", "1", "--evaluate"],
+        cwd="/root/repo", env=env, capture_output=True, text=True,
+        timeout=300)
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    assert "Training complete" in r.stdout
+    assert "Test: loss" in r.stdout
+    assert "(10000 samples)" in r.stdout

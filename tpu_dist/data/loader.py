@@ -168,17 +168,44 @@ class DeviceLoader:
 
     Wraps a ``DataLoader``; each batch becomes a ``jax.Array`` sharded
     ``P(group.axis_name)`` over batch dim 0 (NamedSharding over the group's
-    mesh), with ``prefetch`` transfers in flight — ``jax.device_put`` is
+    mesh), with ``prefetch`` transfers in flight — the staging transfer is
     asynchronous, so compute on batch *i* overlaps the H2D copy of batches
     *i+1..i+prefetch* (the pinned-memory/non_blocking idiom of
     /root/reference/mpspawn_dist.py:88,100-101, compiled away).
+
+    Multi-process placement (``local_shards``): with several processes (the
+    reference's multi-node scenario), each process's DataLoader yields its
+    OWN shard (DistributedSampler), and the global batch is their
+    concatenation — ``jax.make_array_from_process_local_data`` assembles
+    the global Array from per-process rows without any cross-process
+    transfer.  Plain ``jax.device_put`` would be wrong here: it requires
+    the SAME global value on every process (and asserts so).  Pass
+    ``local_shards=False`` when every process intentionally stages
+    identical full global batches (the sequential full-set evaluation
+    pattern in the examples).
     """
 
-    def __init__(self, loader: DataLoader, group=None, prefetch: int = 2):
+    def __init__(self, loader: DataLoader, group=None, prefetch: int = 2,
+                 local_shards: bool = True):
         import tpu_dist.dist as dist
         self.loader = loader
         self.group = group if group is not None else dist.get_default_group()
         self.prefetch = max(1, int(prefetch))
+        self.local_shards = local_shards
+        if self.group.num_processes > 1 and local_shards:
+            sampler = getattr(loader, "sampler", None)
+            if not isinstance(sampler, DistributedSampler):
+                import warnings
+                warnings.warn(
+                    "DeviceLoader(local_shards=True) on a multi-process "
+                    "group treats each process's batches as DISTINCT "
+                    "shards of the global batch, but the wrapped "
+                    "DataLoader has no DistributedSampler — if every "
+                    "process yields the same data, each row will appear "
+                    "num_processes times. Shard with DistributedSampler, "
+                    "or pass local_shards=False for intentionally "
+                    "identical full global batches (the evaluation "
+                    "pattern).", stacklevel=2)
 
     def set_epoch(self, epoch: int) -> None:
         self.loader.set_epoch(epoch)
@@ -191,10 +218,18 @@ class DeviceLoader:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         sharding = NamedSharding(self.group.mesh, P(self.group.axis_name))
+        nproc = self.group.num_processes
+
+        def place(a):
+            a = np.ascontiguousarray(a)
+            if nproc > 1 and self.local_shards:
+                global_shape = (a.shape[0] * nproc,) + a.shape[1:]
+                return jax.make_array_from_process_local_data(
+                    sharding, a, global_shape)
+            return jax.device_put(a, sharding)
 
         def stage(batch):
-            return tuple(jax.device_put(np.ascontiguousarray(a), sharding)
-                         for a in batch)
+            return tuple(place(a) for a in batch)
 
         it = iter(self.loader)
         buf: collections.deque = collections.deque()

@@ -38,7 +38,6 @@ from typing import Any, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -410,6 +409,7 @@ class DistributedDataParallel:
     def _build_eval_step(self):
         module, loss_fn, axis = self.module, self.loss_fn, self.axis
         has_state = module.has_state()
+        ignore = getattr(loss_fn, "ignore_index", None)
 
         # takes only (params, model_state): feeding the whole TrainState
         # would re-lay-out ZeRO-1-sharded opt_state to replicated (an
@@ -419,9 +419,22 @@ class DistributedDataParallel:
                                **({"state": mstate} if has_state else {}))
             if has_state:
                 out, _ = out
-            loss = lax.pmean(loss_fn(out, y), axis)
+            local_mean = loss_fn(out, y)
+            # scored = labels the loss actually counts (ignore_index
+            # excluded) — exact even when padding lands unevenly across
+            # devices: loss_sum = sum over scored labels, not a mean of
+            # per-device means.  (For weight= losses the mean's denominator
+            # is the weight sum, so loss_sum is approximate there.)
+            if ignore is not None:
+                kept = (y != ignore).sum()
+            else:
+                kept = jnp.asarray(y.size, jnp.int32)
+            loss_sum = lax.psum(local_mean * kept, axis)
             correct = lax.psum((out.argmax(-1) == y).sum(), axis)
-            return {"loss": loss, "correct": correct}
+            scored = lax.psum(kept, axis)
+            return {"loss": loss_sum / jnp.maximum(scored, 1),
+                    "loss_sum": loss_sum, "correct": correct,
+                    "scored": scored}
 
         fn = jax.shard_map(local_eval, mesh=self.group.mesh,
                            in_specs=(P(), P(), P(axis), P(axis)),
@@ -484,28 +497,28 @@ class DistributedDataParallel:
 
     def evaluate(self, state: TrainState, loader) -> dict:
         """Drive :meth:`eval_step` over a loader of ``(x, y)`` batches;
-        returns global ``{"loss", "accuracy", "count"}`` (sample-weighted —
-        the torch eval-loop idiom; metrics are identical on every process
-        since ``eval_step`` reduces over the whole mesh).
+        returns global ``{"loss", "accuracy", "count"}`` (the torch
+        eval-loop idiom; metrics are identical on every process since
+        ``eval_step`` reduces over the whole mesh).
 
         Partial batches are padded with ``ignore_index`` labels up to the
         first batch's size rounded to a multiple of the mesh's device count
-        (one compiled shape, always divisible over the data axis): the loss
-        reduction skips ignored labels, and a padded label can never count
-        as correct (argmax is in [0, C)), so ``accuracy`` and ``count``
-        stay exact.  ``count`` is the number of *labels* scored — samples
-        for classification, tokens for sequence models with ``(batch,
-        seq)``-shaped labels.  A padded batch's loss contribution uses
-        per-device means (the torch distributed-eval idiom), a negligible
-        skew on that one batch.  Metrics accumulate on device; the single
-        host readback happens at the end (per-step ``float()`` would
-        serialize eval over the dispatch latency).
+        (one compiled shape, always divisible over the data axis).
+        ``count`` is the number of labels the loss actually *scored*:
+        samples for classification, non-``ignore_index`` tokens for
+        sequence models — batch-padding rows and data-inherent padding
+        tokens are both excluded, from the loss, the accuracy denominator,
+        and the count (a padded label can never count as correct: argmax is
+        in [0, C)).  Loss aggregates as sum-over-scored-labels /
+        total-scored — exact under any padding distribution.  Metrics
+        accumulate on device; the single host readback happens at the end
+        (per-step ``float()`` would serialize eval over the dispatch
+        latency).
         """
         ignore = getattr(self.loss_fn, "ignore_index", -100)
         n_dev = self.group.size()
         pad_to = None
-        total_loss = total_correct = None
-        n = 0
+        total_loss = total_correct = total_scored = None
         for x, y in loader:
             b = int(x.shape[0])
             target = _ceil_to(b, n_dev)
@@ -517,13 +530,17 @@ class DistributedDataParallel:
                     [y, jnp.full((pad_to - b,) + y.shape[1:], ignore,
                                  y.dtype)])
             m = self.eval_step(state, x, y)
-            labels = b * int(np.prod(y.shape[1:], dtype=np.int64))
-            loss_term = m["loss"] * labels
-            total_loss = (loss_term if total_loss is None
-                          else total_loss + loss_term)
-            total_correct = (m["correct"] if total_correct is None
-                             else total_correct + m["correct"])
-            n += labels
+            if total_loss is None:
+                total_loss = m["loss_sum"]
+                total_correct = m["correct"]
+                total_scored = m["scored"]
+            else:
+                total_loss = total_loss + m["loss_sum"]
+                total_correct = total_correct + m["correct"]
+                total_scored = total_scored + m["scored"]
+        if total_loss is None:
+            return {"loss": 0.0, "accuracy": 0.0, "count": 0}
+        n = int(total_scored)
         if n == 0:
             return {"loss": 0.0, "accuracy": 0.0, "count": 0}
         return {"loss": float(total_loss) / n,
