@@ -350,3 +350,27 @@ class TestEvaluateIgnoreTokens:
         logits = model.apply(st.params, xs)
         manual = float((jnp.argmax(logits[:, :5], -1) == ys[:, :5]).mean())
         assert abs(res["accuracy"] - manual) < 1e-6
+
+
+class TestEvaluateNonNegativeIgnore:
+    def test_accuracy_bounded_with_valid_class_ignore(self, pg):
+        """ignore_index that is a valid class id (torch permits it): ignored
+        positions must not count as correct even when argmax lands on the
+        ignore class (regression: accuracy could exceed 1.0)."""
+        from tpu_dist.models import TransformerLM
+        model = TransformerLM(vocab_size=8, dim=16, depth=1, num_heads=2,
+                              max_seq_len=4)
+        ddp = DDP(model, optimizer=optim.SGD(lr=0.1),
+                  loss_fn=nn.CrossEntropyLoss(ignore_index=2), group=pg,
+                  donate=False)
+        st = ddp.init(seed=0)
+        B = pg.size()
+        xs = jnp.asarray(np.zeros((B, 4), np.int32))
+        # make labels EQUAL the model's argmax, then mark half as ignored
+        logits = model.apply(st.params, xs)
+        ys = jnp.argmax(logits, -1).astype(jnp.int32)
+        ys = ys.at[:, 2:].set(2)  # ignored positions (may match argmax)
+        res = ddp.evaluate(st, [(xs, ys)])
+        kept = int((np.asarray(ys) != 2).sum())
+        assert res["count"] == kept
+        assert res["accuracy"] <= 1.0

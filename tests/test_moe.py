@@ -157,7 +157,7 @@ def test_moe_gspmd_dp_ep_matches_single_device(eight_devices, rng):
 
 def test_moe_remat_trains(rng):
     """remat=True + MoE: aux-loss state crosses the jax.checkpoint boundary
-    as explicit outputs (models/transformer.py _run_capturing_state) —
+    as explicit outputs (nn/module.py run_capturing_state) —
     grads must flow and match the remat=False model."""
     vocab = 19
     kw = dict(vocab_size=vocab, dim=DIM, depth=2, num_heads=2,

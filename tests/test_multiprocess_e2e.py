@@ -64,7 +64,7 @@ def test_env_rendezvous_two_processes(tmp_path, nproc):
     env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
     r = subprocess.run(
         [sys.executable, "-m", "tpu_dist.launch",
-         f"--nproc_per_node={nproc}", "--master_port=29711",
+         f"--nproc_per_node={nproc}", "--master_port=0",
          str(script), str(tmp_path)],
         cwd="/root/repo", env=env, capture_output=True, text=True, timeout=300)
     assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"

@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import nn
-from ..nn.module import current_context
+from ..nn.module import current_context, run_capturing_state
 
 __all__ = ["TransformerLM", "TransformerBlock"]
 
@@ -29,24 +29,6 @@ def _norm_cls(norm: str):
     if norm == "rmsnorm":
         return nn.RMSNorm
     raise ValueError(f"Unknown norm {norm!r} (layernorm|rmsnorm)")
-
-
-def _run_capturing_state(block, x):
-    """Run ``block(x)`` with the apply-context's state-update sink swapped
-    for a fresh dict, returning ``(output, captured_updates)`` — so a
-    ``jax.checkpoint``-wrapped block's state writes become explicit remat
-    outputs instead of tracer leaks into the outer trace."""
-    ctx = current_context()
-    if ctx is None or ctx.new_state is None:
-        return block(x), {}
-    saved = ctx.new_state
-    ctx.new_state = {}
-    try:
-        out = block(x)
-        updates = ctx.new_state
-    finally:
-        ctx.new_state = saved
-    return out, updates
 
 
 class TransformerBlock(nn.Module):
@@ -158,7 +140,7 @@ class TransformerLM(nn.Module):
                 # tracers — so they are captured and returned as explicit
                 # checkpoint outputs, then re-published outside.
                 x, updates = jax.checkpoint(
-                    lambda y, _b=block: _run_capturing_state(_b, y))(x)
+                    lambda y, _b=block: run_capturing_state(_b, (y,)))(x)
                 ctx = current_context()
                 for path, val in updates.items():
                     ctx.put_state(path, val)

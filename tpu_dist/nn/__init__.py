@@ -9,10 +9,11 @@ from .layers import (AdaptiveAvgPool2d, AvgPool2d, BatchNorm2d, Conv2d,
                      Linear, MaxPool2d, ReLU, RMSNorm)
 from .loss import CrossEntropyLoss
 from .moe import MoELayer
-from .module import Module, Sequential
+from .module import Module, Remat, Sequential, run_capturing_state
 
 __all__ = [
-    "Module", "Sequential", "functional", "init",
+    "Module", "Remat", "Sequential", "run_capturing_state",
+    "functional", "init",
     "Linear", "Conv2d", "MaxPool2d", "AvgPool2d", "AdaptiveAvgPool2d",
     "ReLU", "Flatten", "Dropout", "BatchNorm2d", "Identity",
     "Embedding", "LayerNorm", "RMSNorm", "GELU",

@@ -30,7 +30,8 @@ from typing import Any, Dict, Iterator, Optional, Tuple
 
 import jax
 
-__all__ = ["Module", "Sequential", "current_context"]
+__all__ = ["Module", "Remat", "Sequential", "current_context",
+           "run_capturing_state"]
 
 
 class _Context:
@@ -260,3 +261,67 @@ class Sequential(Module):
         for i in range(self._length):
             x = getattr(self, str(i))(x)
         return x
+
+
+def run_capturing_state(module: Module, args: tuple, kwargs: dict = None):
+    """Run ``module(*args, **kwargs)`` with the apply-context's state-update
+    sink swapped for a fresh dict, returning ``(output, captured_updates)``.
+
+    This turns a submodule's state writes (BN running stats, MoE aux
+    losses) into explicit return values — required when the call runs
+    inside a ``jax.checkpoint`` sub-trace, where writing to the outer
+    context would leak tracers.  The caller re-publishes the updates via
+    ``ctx.put_state`` outside the checkpointed region."""
+    ctx = current_context()
+    out_kwargs = kwargs or {}
+    if ctx is None or ctx.new_state is None:
+        return module(*args, **out_kwargs), {}
+    saved = ctx.new_state
+    ctx.new_state = {}
+    try:
+        out = module(*args, **out_kwargs)
+        updates = ctx.new_state
+    finally:
+        ctx.new_state = saved
+    return out, updates
+
+
+class Remat(Module):
+    """Activation checkpointing (``torch.utils.checkpoint.checkpoint``
+    parity, as a wrapper module): the wrapped module's forward activations
+    are NOT kept for backward — they are recomputed during the backward
+    pass (``jax.checkpoint``), trading FLOPs for HBM.
+
+    Usage::
+
+        block = nn.Remat(TransformerBlock(...))
+        y = block(x)
+
+    NOTE: wrapping inserts one level into parameter paths — the wrapped
+    module's params live under the ``inner`` attribute (``"<name>.X"``
+    becomes ``"<name>.inner.X"``), so checkpoints trained without the
+    wrapper need their keys remapped (or wrap before the first init).
+
+    ``policy`` forwards to ``jax.checkpoint`` (e.g.
+    ``jax.checkpoint_policies.dots_with_no_batch_dims_saveable`` keeps
+    matmul outputs and recomputes the rest).  Keyword arguments and the
+    module's parameters reach the inner module as closed-over values —
+    ``jax.checkpoint`` differentiates through closures, so no explicit
+    plumbing is needed; state updates are captured and re-published
+    outside the sub-trace (see :func:`run_capturing_state`)."""
+
+    def __init__(self, module: Module, policy=None):
+        super().__init__()
+        self.inner = module
+        self.policy = policy
+
+    def forward(self, *args, **kwargs):
+        def inner_fn(*a):
+            return run_capturing_state(self.inner, a, kwargs)
+
+        out, updates = jax.checkpoint(inner_fn, policy=self.policy)(*args)
+        ctx = current_context()
+        if ctx is not None and updates:
+            for path, val in updates.items():
+                ctx.put_state(path, val)
+        return out

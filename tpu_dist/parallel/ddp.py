@@ -425,12 +425,18 @@ class DistributedDataParallel:
             # devices: loss_sum = sum over scored labels, not a mean of
             # per-device means.  (For weight= losses the mean's denominator
             # is the weight sum, so loss_sum is approximate there.)
+            hit = out.argmax(-1) == y
             if ignore is not None:
-                kept = (y != ignore).sum()
+                keep = y != ignore
+                kept = keep.sum()
+                # mask the numerator too: if ignore_index is a valid class
+                # id (torch permits >= 0), argmax CAN equal it at ignored
+                # positions — unmasked, accuracy would exceed 1.0
+                hit = hit & keep
             else:
                 kept = jnp.asarray(y.size, jnp.int32)
             loss_sum = lax.psum(local_mean * kept, axis)
-            correct = lax.psum((out.argmax(-1) == y).sum(), axis)
+            correct = lax.psum(hit.sum(), axis)
             scored = lax.psum(kept, axis)
             return {"loss": loss_sum / jnp.maximum(scored, 1),
                     "loss_sum": loss_sum, "correct": correct,
