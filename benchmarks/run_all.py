@@ -69,7 +69,8 @@ def main() -> None:
         try:
             r = fn()
         except Exception as e:  # record the failure, keep the rest running
-            r = {"metric": metric_names[name], "error": repr(e)[:500]}
+            r = {"metric": metric_names.get(name, name),
+                 "error": repr(e)[:500]}
         old = previous.get(r.get("metric"))
         if old is not None:
             r = _better(r, old)

@@ -121,8 +121,10 @@ def test_fsdp_none_leaves_pass_through(eight_devices):
 
 
 def test_fsdp_composes_with_tp_rules(eight_devices):
-    """TP-first-then-FSDP: TP-sharded leaves keep their placement, the
-    remaining replicated leaves get data-sharded — the docstring recipe."""
+    """TP-first-then-FSDP: TP-sharded leaves KEEP their model-axis
+    placement and gain the fsdp axis on a free dim (2-D weight sharding,
+    the Megatron+ZeRO-3 hybrid); remaining replicated leaves get
+    data-sharded — the docstring recipe."""
     from tpu_dist.parallel import TRANSFORMER_TP_RULES, shard_pytree
     dist.init_process_group(backend="cpu", axis_names=("data", "model"),
                             mesh_shape=(2, 4))
@@ -132,9 +134,55 @@ def test_fsdp_composes_with_tp_rules(eight_devices):
                           max_seq_len=T)
     params = shard_pytree(model.init(jax.random.key(0)), mesh,
                           TRANSFORMER_TP_RULES)
-    qkv_before = params["block0.attn"]["qkv_weight"].sharding.spec
-    assert qkv_before == P(None, "model")
+    assert params["block0.attn"]["qkv_weight"].sharding.spec == \
+        P(None, "model")
     params = fsdp_shard(params, mesh, min_size=128)
-    # TP placement survives; a previously-replicated large leaf sharded
-    assert params["block0.attn"]["qkv_weight"].sharding.spec == qkv_before
+    # TP axis survives; the free dim picks up the data axis
+    assert params["block0.attn"]["qkv_weight"].sharding.spec == \
+        P("data", "model")
     assert params["pos"]["weight"].sharding.spec != P()
+
+
+def test_3d_dp_fsdp_tp_matches_single_device(eight_devices):
+    """Full 3-D mesh (data=2, fsdp=2, model=2): batch over 'data', weights
+    2-D-sharded over ('fsdp', 'model') — one GSPMD step == the unsharded
+    single-device step."""
+    from tpu_dist.parallel import TRANSFORMER_TP_RULES, shard_pytree
+    dist.init_process_group(backend="cpu",
+                            axis_names=("data", "fsdp", "model"),
+                            mesh_shape=(2, 2, 2))
+    mesh = dist.get_default_group().mesh
+    model = TransformerLM(vocab_size=32, dim=32, depth=1, num_heads=2,
+                          max_seq_len=T)
+    ce = nn.CrossEntropyLoss()
+    loss_fn = lambda lg, y: ce(lg.reshape(-1, 32), y.reshape(-1))
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, 32, (8, T)))
+    y = jnp.asarray(rng.integers(0, 32, (8, T)))
+    opt = optim.SGD(lr=0.1)
+    params0 = model.init(jax.random.key(0))
+
+    # single-device oracle
+    def objective(p):
+        return loss_fn(model.apply(p, x), y)
+
+    ref_loss, grads = jax.value_and_grad(objective)(params0)
+    ref_p, _ = opt.update(grads, opt.init(params0), params0)
+
+    params = shard_pytree(params0, mesh, TRANSFORMER_TP_RULES)
+    params = fsdp_shard(params, mesh, axis="fsdp", min_size=128)
+    qkv = params["block0.attn"]["qkv_weight"]
+    assert qkv.sharding.spec == P("fsdp", "model")  # 2-D weight sharding
+    opt_state = fsdp_shard(opt.init(params), mesh, axis="fsdp",
+                           min_size=128)
+    step = make_gspmd_train_step(model, loss_fn, opt)
+    bsh = NamedSharding(mesh, P("data", None))
+    new_p, _, m = step(params, opt_state, jax.device_put(x, bsh),
+                       jax.device_put(y, bsh))
+    np.testing.assert_allclose(float(m["loss"]), float(ref_loss), rtol=1e-5)
+    # updated params keep their 2-D placement and match the oracle
+    assert new_p["block0.attn"]["qkv_weight"].sharding.spec == \
+        P("fsdp", "model")
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), atol=2e-5),
+        jax.device_get(new_p), ref_p)

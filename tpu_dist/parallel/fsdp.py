@@ -25,8 +25,12 @@ Usage::
     step = make_gspmd_train_step(model, loss_fn, opt)   # ordinary step
     ...batch placed P('data'), exactly like the gspmd tp recipe...
 
-Composable with tensor parallelism: on a ('data', 'model') mesh apply
-TRANSFORMER_TP_RULES first and FSDP on the remaining replicated leaves.
+Composable with tensor parallelism: on a ('data', 'model') (or 3-D
+('data', 'fsdp', 'model')) mesh apply TRANSFORMER_TP_RULES first, then
+``fsdp_shard`` — existing placements keep their axes and gain the fsdp
+axis on their largest still-replicated divisible dim (2-D weight
+sharding, the Megatron+ZeRO-3 hybrid); leaves already carrying the fsdp
+axis are left alone.
 """
 
 from __future__ import annotations
@@ -52,13 +56,27 @@ def _existing_spec(leaf) -> Optional[P]:
 def _leaf_spec(leaf, axis: str, axis_size: int, min_size: int) -> P:
     if leaf is None:
         return P()
+    shape = getattr(leaf, "shape", ())
     existing = _existing_spec(leaf)
     if existing is not None:
         # already placed by another strategy (e.g. TP rules on a
-        # ('data','model') mesh): keep it — FSDP takes the remaining
-        # replicated leaves, per the composition recipe in the docstring
+        # ('data','fsdp','model') mesh): keep those axes and ADD the fsdp
+        # axis on the largest still-replicated divisible dim — 2-D weight
+        # sharding (ZeRO-3 x TP), the Megatron+FSDP hybrid.  No free dim,
+        # or this axis already placed (re-sharding an already-FSDP leaf,
+        # e.g. opt states inheriting param shardings) → leave it alone.
+        already = any(axis == a or (isinstance(a, tuple) and axis in a)
+                      for a in existing)
+        if not already and int(np.prod(shape)) >= min_size:
+            free = [d for d in range(len(shape))
+                    if d >= len(existing) or existing[d] is None]
+            for d in sorted(free, key=lambda d: shape[d], reverse=True):
+                if shape[d] % axis_size == 0:
+                    spec = list(existing) + [None] * (len(shape)
+                                                      - len(existing))
+                    spec[d] = axis
+                    return P(*spec)
         return existing
-    shape = getattr(leaf, "shape", ())
     if not shape or int(np.prod(shape)) < min_size:
         return P()
     order = sorted(range(len(shape)), key=lambda d: shape[d], reverse=True)
@@ -73,8 +91,10 @@ def _leaf_spec(leaf, axis: str, axis_size: int, min_size: int) -> P:
 def fsdp_specs(tree, mesh, axis: str = "data", min_size: int = 2 ** 12):
     """PartitionSpec pytree: each leaf's largest ``axis_size``-divisible
     dim sharded over ``axis``; leaves smaller than ``min_size`` elements
-    (or with no divisible dim) replicate; leaves that already carry a
-    non-trivial sharding (TP/EP placements) keep it unchanged."""
+    (or with no divisible dim) replicate.  Leaves already carrying a
+    non-trivial sharding (TP/EP placements) keep those axes and gain
+    ``axis`` on their largest free divisible dim (2-D weight sharding);
+    if ``axis`` is already placed on the leaf, it is left unchanged."""
     size = mesh.shape[axis]
     return jax.tree.map(
         lambda l: _leaf_spec(l, axis, size, min_size), tree,
