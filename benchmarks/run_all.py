@@ -28,11 +28,34 @@ def _better(new: dict, old: dict) -> dict:
         return new
     if "value" in new and "value" in old:
         return new if new["value"] >= old["value"] else old
+    if new.get("metric") == "flash_attention_causal_bf16":
+        # per-row ratchet on the flash fwd+bwd TFLOPs, with a plausibility
+        # gate: a row whose fwd+bwd measured faster than fwd alone is a
+        # contention artifact and must not be locked in as "best"
+        def plausible(row):
+            f = row.get("flash", {})
+            return f.get("fwd_bwd_ms", 0) >= 0.9 * f.get("fwd_ms", 0)
+
+        def tflops(row):
+            return row.get("flash", {}).get("fwd_bwd_tflops", 0)
+
+        rows = []
+        old_rows = {r.get("seq_len"): r for r in old.get("rows", [])}
+        for r in new.get("rows", []):
+            o = old_rows.get(r.get("seq_len"))
+            if o is None:
+                rows.append(r if plausible(r) else r)
+            elif plausible(r) and (tflops(r) >= tflops(o)
+                                   or not plausible(o)):
+                rows.append(r)
+            else:
+                rows.append(o)
+        merged = dict(new)
+        merged["rows"] = rows
+        return merged
     key = {
         "imagenet_input_pipeline_vs_resnet50_step":
             lambda e: e.get("resnet50_bf16_step_images_per_sec", 0),
-        "flash_attention_causal_bf16":
-            lambda e: e["rows"][0].get("flash_speedup_fwd_bwd", 0),
     }.get(new.get("metric"))
     if key is not None:
         return new if key(new) >= key(old) else old
