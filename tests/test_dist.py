@@ -174,3 +174,14 @@ class TestRendezvousParsing:
         monkeypatch.setenv("WORLD_SIZE", "2")
         monkeypatch.setenv("RANK", "1")
         assert dist.parse_init_method(None) == ("h:1", 2, 1)
+
+
+class TestGetBackend:
+    def test_backend_normalization_and_query(self):
+        if dist.is_initialized():
+            dist.destroy_process_group()
+        dist.init_process_group(backend="gloo")  # alias -> cpu
+        assert dist.get_backend() == "cpu"
+        sub = dist.new_group(ranks=range(2))
+        assert dist.get_backend(sub) == "cpu"  # subgroups inherit
+        dist.destroy_process_group()

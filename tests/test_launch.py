@@ -8,6 +8,8 @@ import textwrap
 
 import pytest
 
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 from tpu_dist.launch import (ProcessExitedException, ProcessRaisedException,
                              spawn)
 from tpu_dist.launch.cli import build_parser, main
@@ -187,3 +189,28 @@ class TestElasticRestart:
 
     def test_negative_rejected(self):
         assert main(["--max_restarts=-1", "x.py"]) == 2
+
+
+class TestStandaloneAndRunAlias:
+    def test_standalone_flag(self, tmp_path):
+        """--standalone (torchrun parity): single-node auto-rendezvous."""
+        script = tmp_path / "w.py"
+        script.write_text(
+            "import os, sys\n"
+            "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+            "import jax\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            "import tpu_dist.dist as dist\n"
+            "dist.init_process_group(backend='cpu', init_method='env://')\n"
+            "print('standalone rank', dist.get_rank(), 'backend',\n"
+            "      dist.get_backend())\n"
+            "dist.destroy_process_group()\n")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+        r = subprocess.run(
+            [sys.executable, "-m", "tpu_dist.run", "--standalone",
+             "--nproc_per_node=2", str(script)],
+            cwd=_REPO, env=env, capture_output=True, text=True, timeout=300)
+        assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+        assert "standalone rank 0 backend cpu" in r.stdout
+        assert "standalone rank 1 backend cpu" in r.stdout

@@ -6,10 +6,11 @@ TPU topology (one process per host, a mesh of cores, XLA collectives).
 """
 
 from .process_group import (DATA_AXIS, ProcessGroup, barrier,
-                            destroy_process_group, get_default_group,
-                            get_local_rank, get_local_world_size,
-                            get_num_processes, get_rank, get_world_size,
-                            init_process_group, is_initialized, new_group)
+                            destroy_process_group, get_backend,
+                            get_default_group, get_local_rank,
+                            get_local_world_size, get_num_processes,
+                            get_rank, get_world_size, init_process_group,
+                            is_initialized, new_group)
 from .rendezvous import parse_init_method, rendezvous
 from .store import Store, TCPStore, FileStore
 from ..collectives.eager import ReduceOp  # torch `dist.ReduceOp` parity
@@ -17,6 +18,7 @@ from ..collectives.eager import ReduceOp  # torch `dist.ReduceOp` parity
 __all__ = [
     "ProcessGroup", "init_process_group", "destroy_process_group",
     "is_initialized", "get_default_group", "get_world_size", "get_rank",
+    "get_backend",
     "get_local_rank", "get_local_world_size", "get_num_processes",
     "new_group", "barrier", "DATA_AXIS",
     "rendezvous", "parse_init_method",

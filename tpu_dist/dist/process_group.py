@@ -224,6 +224,7 @@ def init_process_group(backend: str = "tpu",
         devices = jax.devices()
         group = ProcessGroup(devices, axis_names=axis_names,
                              mesh_shape=mesh_shape)
+        group._backend = backend
         _DEFAULT_GROUP = group
         return group
 
@@ -259,6 +260,15 @@ def get_rank(group: Optional[ProcessGroup] = None) -> int:
     return _group(group).rank
 
 
+def get_backend(group: Optional[ProcessGroup] = None) -> str:
+    """torch ``dist.get_backend`` parity: the group's normalized backend
+    string — ``'tpu'`` (XLA collectives; accepts the aliases nccl/xla at
+    init) or ``'cpu'`` (accepts gloo).  Subgroups inherit their parent's
+    backend at creation (stamped in :func:`new_group`, so the answer
+    stays right even after the default group is recycled)."""
+    return getattr(_group(group), "_backend", None) or "tpu"
+
+
 def get_num_processes(group: Optional[ProcessGroup] = None) -> int:
     return _group(group).num_processes
 
@@ -287,8 +297,10 @@ def new_group(ranks: Optional[Sequence[int]] = None,
     if ranks is None:
         ranks = range(default.size())
     devices = [default.devices[r] for r in ranks]
-    return ProcessGroup(devices, axis_names=axis_names, mesh_shape=mesh_shape,
-                        parent=default)
+    group = ProcessGroup(devices, axis_names=axis_names,
+                         mesh_shape=mesh_shape, parent=default)
+    group._backend = getattr(default, "_backend", None)
+    return group
 
 
 def barrier(group: Optional[ProcessGroup] = None) -> None:

@@ -83,6 +83,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "times after a worker failure (torchrun elastic "
                         "parity); children see TPU_DIST_RESTART_COUNT and "
                         "should resume from their latest checkpoint")
+    p.add_argument("--standalone", action="store_true",
+                   help="single-node mode with automatic rendezvous "
+                        "(torchrun parity): forces --nnodes=1 "
+                        "--node_rank=0 and a free master port")
     p.add_argument("--module", "-m", action="store_true",
                    help="treat script as a python module (python -m ...)")
     p.add_argument("script", type=str)
@@ -278,6 +282,19 @@ def _watch_world(args, procs: List[subprocess.Popen], store,
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.standalone:
+        overridden = [f for f, default in (("--nnodes", 1),
+                                           ("--node_rank", 0),
+                                           ("--master_port", 29500))
+                      if getattr(args, f[2:]) != default]
+        if overridden:
+            sys.stderr.write(
+                f"--standalone overrides {', '.join(overridden)} "
+                f"(single-node, auto rendezvous port)\n")
+        args.nnodes, args.node_rank = 1, 0
+        # torchrun's --standalone needs no store: pick the port directly
+        # rather than via store negotiation (which --no_store disables)
+        args.master_port = _free_port() if args.no_store else 0
     if args.node_rank >= args.nnodes or args.node_rank < 0:
         sys.stderr.write(f"--node_rank {args.node_rank} out of range for "
                          f"--nnodes {args.nnodes}\n")

@@ -1,0 +1,14 @@
+"""``python -m tpu_dist.run`` — torchrun-style alias for the launch CLI.
+
+torch renamed its launcher ``torch.distributed.launch`` →
+``torch.distributed.run`` (torchrun); both module names work here too:
+``python -m tpu_dist.launch ...`` and ``python -m tpu_dist.run ...`` are
+the same CLI (tpu_dist/launch/cli.py).
+"""
+
+import sys
+
+from .launch.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())
