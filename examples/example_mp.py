@@ -47,6 +47,19 @@ def main():
                         help="bfloat16 compute (BASELINE.md ladder #4)")
     parser.add_argument("--evaluate", action="store_true",
                         help="run test-set evaluation after training")
+    parser.add_argument("--checkpoint-dir", default=None, type=str,
+                        help="save TrainState checkpoints here")
+    def _positive(v):
+        v = int(v)
+        if v < 1:
+            raise argparse.ArgumentTypeError("must be >= 1")
+        return v
+
+    parser.add_argument("--checkpoint-every", default=100, type=_positive,
+                        help="steps between checkpoints")
+    parser.add_argument("--resume", action="store_true",
+                        help="resume from the latest checkpoint in "
+                             "--checkpoint-dir")
     args = parser.parse_args()
 
     if args.backend == "cpu":
@@ -56,7 +69,7 @@ def main():
 
     import jax
     import tpu_dist.dist as dist
-    from tpu_dist import nn, optim
+    from tpu_dist import checkpoint, nn, optim
     from tpu_dist.data import (CIFAR10, DataLoader, DeviceLoader,
                                DistributedSampler, transforms)
     from tpu_dist.models import resnet18
@@ -90,6 +103,31 @@ def main():
         sync_batchnorm=args.sync_bn, compute_dtype=compute_dtype)
     state = ddp.init(seed=0)
 
+    if args.resume:
+        if not args.checkpoint_dir:
+            raise SystemExit("--resume requires --checkpoint-dir")
+        # every process must take the SAME restore-or-fresh branch (restore
+        # of sharded state is collective): process 0 decides, the decision
+        # is broadcast.  Non-shared checkpoint dirs then fail loudly on
+        # non-zero processes instead of silently diverging.
+        from tpu_dist import collectives
+        last = None
+        if dist.get_num_processes() == 1 or jax.process_index() == 0:
+            last = checkpoint.latest_step(args.checkpoint_dir)
+        if dist.get_num_processes() > 1:
+            (last,) = collectives.broadcast_object_list([last], src=0,
+                                                        group=pg)
+        if last is None:
+            if rank == 0:
+                print(f"no checkpoint under {args.checkpoint_dir}; "
+                      f"starting fresh")
+        else:
+            state = checkpoint.restore(args.checkpoint_dir, state,
+                                       step=last,
+                                       sharding=ddp.state_shardings(state))
+            if rank == 0:
+                print(f"resumed from step {last}")
+
     aug = transforms.Compose([
         transforms.RandomCrop(32, padding=4),
         transforms.RandomHorizontalFlip(),
@@ -109,6 +147,7 @@ def main():
     total_step = len(loader.loader)
     start = datetime.now()
     steps = 0
+    last_saved = -1
     for ep in range(args.epochs):
         sampler.set_epoch(ep)  # epoch-seeded reshuffle (ref :100)
         running_loss, running_correct, seen = 0.0, 0, 0
@@ -125,10 +164,17 @@ def main():
                           ep + 1, args.epochs, i + 1, total_step,
                           running_loss / 25, running_correct / max(seen, 1)))
                 running_loss, running_correct, seen = 0.0, 0, 0
+            if args.checkpoint_dir and steps % args.checkpoint_every == 0:
+                last_saved = int(state.step)
+                checkpoint.save(args.checkpoint_dir, state, step=last_saved,
+                                keep=3)
             if args.max_steps and steps >= args.max_steps:
                 break
         if args.max_steps and steps >= args.max_steps:
             break
+    if args.checkpoint_dir and int(state.step) != last_saved:
+        checkpoint.save(args.checkpoint_dir, state, step=int(state.step),
+                        keep=3)
     if rank == 0:
         print("Training complete in: " + str(datetime.now() - start))
 

@@ -222,3 +222,32 @@ class TestAsyncCheckpointer:
                                                             np.float32)},
                       step=0)
         np.testing.assert_array_equal(got["a"], np.zeros(1024, np.float32))
+
+
+class TestExampleResume:
+    def test_example_mp_checkpoint_and_resume(self, tmp_path):
+        """examples/example_mp.py --checkpoint-dir/--resume round-trip:
+        train, checkpoint, resume from the latest step."""
+        import os
+        import subprocess
+        import sys
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        base = [sys.executable, os.path.join(repo, "examples/example_mp.py"),
+                "--backend", "cpu", "--synthetic", "--epochs", "1",
+                "--batch-size", "32", "--checkpoint-dir", str(tmp_path)]
+        r1 = subprocess.run(base + ["--max-steps", "3",
+                                    "--checkpoint-every", "2"],
+                            env=env, capture_output=True, text=True,
+                            timeout=300)
+        assert r1.returncode == 0, r1.stderr
+        assert sorted(os.listdir(tmp_path)) == ["step_00000002",
+                                                "step_00000003"]
+        r2 = subprocess.run(base + ["--max-steps", "2", "--resume"],
+                            env=env, capture_output=True, text=True,
+                            timeout=300)
+        assert r2.returncode == 0, r2.stderr
+        assert "resumed from step 3" in r2.stdout
+        # resumed run checkpointed past the restored step
+        assert "step_00000005" in os.listdir(tmp_path)
