@@ -1,0 +1,239 @@
+"""Convergence/accuracy recording — the BASELINE.json north star.
+
+Runs the two reference training recipes end-to-end on the real chip and
+writes ``ACCURACY.json`` with per-epoch loss, per-epoch (CIFAR) / final
+(MNIST) test accuracy, and wall-clock — the artifact matching the
+reference's only recorded result (its README screenshot of a 2-node MNIST
+run with per-25/100-step loss+acc logs, /root/reference/README.md:213-223,
+/root/reference/example_mp.py:115-127).
+
+Recipes (hyperparameters identical to the examples, which mirror the
+reference scripts):
+
+- **MNIST ConvNet** (examples/mpspawn_dist.py): SGD lr=1e-4, per-replica
+  batch 100, seed 0, 2 epochs — the reference's exact configuration.
+- **CIFAR-10 ResNet-18 bf16** (examples/example_mp.py): SGD lr=.02,
+  momentum .9, weight_decay 1e-4, nesterov, global batch 256, pad-4 crop
+  + flip augmentation, per-epoch sampler reshuffle, bf16 compute.
+
+Data: the sandbox has no egress, so both use the deterministic synthetic
+fallbacks (data/datasets.py `_synthetic` — class-templated, learnable);
+``"data": "synthetic"`` is stamped in the artifact.  Loss/accuracy values
+are therefore NOT comparable to real-MNIST numbers; what the artifact
+proves is the north-star *behavior*: loss falls monotonically epoch over
+epoch and held-out accuracy converges, through the full example pipeline
+(sampler -> loader -> DDP fused step -> evaluate) on TPU hardware.
+
+Usage: python -m benchmarks.accuracy_run  [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _epoch_pass(ddp, state, loader, log_every=0, tag=""):
+    """One epoch of per-step training; returns (state, mean_loss, steps)."""
+    total, steps = 0.0, 0
+    for i, (images, labels) in enumerate(loader):
+        state, metrics = ddp.train_step(state, images, labels)
+        total += float(metrics["loss"])
+        steps += 1
+        if log_every and (i + 1) % log_every == 0:
+            print(f"  {tag} step {i + 1}: loss {float(metrics['loss']):.4f}",
+                  flush=True)
+    return state, total / max(steps, 1), steps
+
+
+def run_mnist(epochs: int = 2, batch_per_replica: int = 100,
+              lr: float = 1e-4, momentum: float = 0.0) -> dict:
+    """Reference mpspawn_dist recipe (SGD 1e-4, batch 100, seed 0).
+
+    The reference's lr is deliberately tiny (tutorial pacing,
+    /root/reference/mpspawn_dist.py:64) — loss declines slowly but
+    monotonically, which is exactly what its README screenshot shows.
+    ``lr``/``momentum`` overrides produce the *tuned* row that
+    demonstrates accuracy convergence with the same model/pipeline."""
+    import tpu_dist.dist as dist
+    from tpu_dist import nn, optim
+    from tpu_dist.data import (DataLoader, DeviceLoader, DistributedSampler,
+                               MNIST, transforms)
+    from tpu_dist.models import ConvNet
+    from tpu_dist.parallel import DistributedDataParallel
+
+    pg = dist.init_process_group()
+    world = dist.get_world_size()
+    norm = transforms.Normalize(transforms.MNIST_MEAN, transforms.MNIST_STD)
+    train_ds = MNIST(root="./data", train=True, transform=norm,
+                     synthetic_fallback=True)
+    test_ds = MNIST(root="./data", train=False, transform=norm,
+                    synthetic_fallback=True)
+    ddp = DistributedDataParallel(
+        ConvNet(), optimizer=optim.SGD(lr=lr, momentum=momentum),
+        loss_fn=nn.CrossEntropyLoss(), group=pg)
+    state = ddp.init(seed=0)
+
+    global_batch = batch_per_replica * world
+    sampler = DistributedSampler(train_ds,
+                                 num_replicas=dist.get_num_processes(),
+                                 rank=dist.get_rank(), shuffle=False)
+    loader = DeviceLoader(
+        DataLoader(train_ds, batch_size=global_batch, sampler=sampler,
+                   drop_last=True, num_workers=2), group=pg)
+    test_loader = DeviceLoader(
+        DataLoader(test_ds, batch_size=global_batch, drop_last=False,
+                   num_workers=2), group=pg, local_shards=False)
+
+    t0 = time.perf_counter()
+    epoch_losses = []
+    epoch_test = []
+    for ep in range(epochs):
+        loader.set_epoch(ep)
+        state, mean_loss, steps = _epoch_pass(ddp, state, loader,
+                                              log_every=100,
+                                              tag=f"mnist ep{ep + 1}")
+        res = ddp.evaluate(state, test_loader)
+        epoch_losses.append(round(mean_loss, 4))
+        epoch_test.append({"loss": round(res["loss"], 4),
+                           "accuracy": round(res["accuracy"], 4)})
+        print(f"mnist epoch {ep + 1}/{epochs}: train loss {mean_loss:.4f}, "
+              f"test acc {res['accuracy']:.4f}", flush=True)
+    wall = time.perf_counter() - t0
+    final = epoch_test[-1]
+    out = {
+        "recipe": f"mnist_convnet_sgd{lr:g}_m{momentum:g}_batch100_seed0 "
+                  "(examples/mpspawn_dist.py)",
+        "data": "synthetic (no egress; datasets.py deterministic fallback)",
+        "device_replicas": world,
+        "epochs": epochs,
+        "steps_per_epoch": steps,
+        "train_loss_per_epoch": epoch_losses,
+        "test_per_epoch": epoch_test,
+        "final_test_accuracy": final["accuracy"],
+        "final_test_loss": final["loss"],
+        "test_samples": res["count"],
+        "wall_clock_sec": round(wall, 1),
+    }
+    dist.destroy_process_group()
+    return out
+
+
+def run_cifar(epochs: int = 5, global_batch: int = 256) -> dict:
+    """Reference example_mp recipe (ResNet-18, SGD .02/.9/1e-4/nesterov,
+    aug, per-epoch reshuffle) with --bf16."""
+    import jax.numpy as jnp
+    import tpu_dist.dist as dist
+    from tpu_dist import nn, optim
+    from tpu_dist.data import (CIFAR10, DataLoader, DeviceLoader,
+                               DistributedSampler, transforms)
+    from tpu_dist.models import resnet18
+    from tpu_dist.parallel import DistributedDataParallel
+
+    pg = dist.init_process_group()
+    world = dist.get_world_size()
+    aug = transforms.Compose([
+        transforms.RandomCrop(32, padding=4),
+        transforms.RandomHorizontalFlip(),
+        transforms.Normalize(transforms.CIFAR10_MEAN, transforms.CIFAR10_STD),
+    ])
+    norm = transforms.Normalize(transforms.CIFAR10_MEAN,
+                                transforms.CIFAR10_STD)
+    train_ds = CIFAR10(root="./data", train=True, transform=aug,
+                       synthetic_fallback=True)
+    test_ds = CIFAR10(root="./data", train=False, transform=norm,
+                      synthetic_fallback=True)
+    ddp = DistributedDataParallel(
+        resnet18(num_classes=10),
+        optimizer=optim.SGD(lr=0.02, momentum=0.9, weight_decay=1e-4,
+                            nesterov=True),
+        loss_fn=nn.CrossEntropyLoss(), group=pg,
+        compute_dtype=jnp.bfloat16)
+    state = ddp.init(seed=0)
+
+    sampler = DistributedSampler(train_ds,
+                                 num_replicas=dist.get_num_processes(),
+                                 rank=dist.get_rank(), shuffle=True, seed=0)
+    loader = DeviceLoader(
+        DataLoader(train_ds, batch_size=global_batch, sampler=sampler,
+                   drop_last=True, num_workers=2), group=pg)
+    test_loader = DeviceLoader(
+        DataLoader(test_ds, batch_size=global_batch, drop_last=False,
+                   num_workers=2), group=pg, local_shards=False)
+
+    t0 = time.perf_counter()
+    epoch_losses = []
+    epoch_test = []
+    for ep in range(epochs):
+        loader.set_epoch(ep)  # per-epoch reshuffle (ref set_epoch)
+        state, mean_loss, steps = _epoch_pass(ddp, state, loader,
+                                              log_every=50,
+                                              tag=f"cifar ep{ep + 1}")
+        res = ddp.evaluate(state, test_loader)
+        epoch_losses.append(round(mean_loss, 4))
+        epoch_test.append({"loss": round(res["loss"], 4),
+                           "accuracy": round(res["accuracy"], 4)})
+        print(f"cifar epoch {ep + 1}/{epochs}: train loss {mean_loss:.4f}, "
+              f"test acc {res['accuracy']:.4f}", flush=True)
+    wall = time.perf_counter() - t0
+    final = epoch_test[-1]
+    out = {
+        "recipe": "cifar10_resnet18_bf16_sgd.02_batch256_aug "
+                  "(examples/example_mp.py --bf16)",
+        "data": "synthetic (no egress; datasets.py deterministic fallback)",
+        "device_replicas": world,
+        "epochs": epochs,
+        "steps_per_epoch": steps,
+        "train_loss_per_epoch": epoch_losses,
+        "test_per_epoch": epoch_test,
+        "final_test_accuracy": final["accuracy"],
+        "final_test_loss": final["loss"],
+        "test_samples": res["count"],
+        "wall_clock_sec": round(wall, 1),
+    }
+    dist.destroy_process_group()
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="1 epoch each (smoke; does not overwrite a longer "
+                         "recording)")
+    ap.add_argument("--mnist-epochs", type=int, default=2)
+    ap.add_argument("--cifar-epochs", type=int, default=5)
+    args = ap.parse_args()
+    if args.quick:
+        args.mnist_epochs = args.cifar_epochs = 1
+
+    import jax
+    platform = jax.devices()[0].platform
+    results = {"platform": platform,
+               "device": str(jax.devices()[0]),
+               # ref-exact hyperparams: slow monotone decline, like the
+               # reference's own screenshot
+               "mnist_convnet_ref_recipe": run_mnist(epochs=args.mnist_epochs),
+               # same model/pipeline, workable lr: accuracy convergence
+               "mnist_convnet_tuned": run_mnist(
+                   epochs=max(1, args.mnist_epochs // 2), lr=0.05,
+                   momentum=0.9),
+               "cifar10_resnet18_bf16": run_cifar(epochs=args.cifar_epochs)}
+
+    out = os.path.join(_REPO, "ACCURACY.json")
+    if args.quick and os.path.exists(out):
+        print("quick mode: not overwriting existing ACCURACY.json")
+        print(json.dumps(results, indent=1))
+        return
+    with open(out, "w") as f:
+        json.dump(results, f, indent=1)
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, _REPO)
+    main()

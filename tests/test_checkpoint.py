@@ -10,6 +10,9 @@ import tpu_dist.dist as dist
 from tpu_dist import checkpoint, nn, optim
 from tpu_dist.models import ConvNet
 from tpu_dist.parallel import DDP
+# compile-heavy file: excluded from the fast tier (`pytest -m "not slow"`)
+pytestmark = pytest.mark.slow
+
 
 
 @pytest.fixture

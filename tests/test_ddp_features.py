@@ -11,6 +11,9 @@ import tpu_dist.dist as dist
 from tpu_dist import nn, optim
 from tpu_dist.models import ConvNet
 from tpu_dist.parallel import DDP
+# compile-heavy file: excluded from the fast tier (`pytest -m "not slow"`)
+pytestmark = pytest.mark.slow
+
 
 
 @pytest.fixture
@@ -350,6 +353,28 @@ class TestEvaluateIgnoreTokens:
         logits = model.apply(st.params, xs)
         manual = float((jnp.argmax(logits[:, :5], -1) == ys[:, :5]).mean())
         assert abs(res["accuracy"] - manual) < 1e-6
+
+
+class TestEvaluateCustomLossNoIgnore:
+    def test_partial_batch_exact_without_ignore_index(self, pg):
+        """A loss_fn with NO ignore_index attribute: evaluate masks batch
+        padding positionally (true row count), so padded rows never enter
+        the loss, the accuracy denominator, or the count (regression:
+        ADVICE r2 — padded rows were scored for custom losses)."""
+        def brier(logits, y):  # plain callable, no ignore_index attr
+            onehot = jax.nn.one_hot(y, logits.shape[-1])
+            return jnp.mean((jax.nn.softmax(logits) - onehot) ** 2)
+
+        ddp = DDP(ConvNet(), optimizer=optim.SGD(lr=0.05),
+                  loss_fn=brier, group=pg, donate=False)
+        st = ddp.init(seed=0)
+        x, y = _batch(168, seed=3)
+        # batch 2 is partial → padded up to batch 1's size internally
+        padded = ddp.evaluate(st, [(x[:128], y[:128]), (x[128:], y[128:])])
+        exact = ddp.evaluate(st, [(x, y)])
+        assert padded["count"] == 168
+        assert abs(padded["accuracy"] - exact["accuracy"]) < 1e-9
+        np.testing.assert_allclose(padded["loss"], exact["loss"], rtol=1e-5)
 
 
 class TestEvaluateNonNegativeIgnore:

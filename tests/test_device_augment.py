@@ -1,0 +1,119 @@
+"""On-device augmentation (DeviceAugment): parity with the host transforms'
+resample math, exactness in degenerate configs, and loader integration."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import tpu_dist.dist as dist
+from tpu_dist.data import (DataLoader, DeviceAugment, DeviceLoader,
+                           SyntheticImageNet, transforms)
+from tpu_dist.data.device_augment import bilinear_crop_resize
+from tpu_dist.data.transforms import _bilinear_crop_resize_numpy
+
+
+@pytest.fixture
+def pg():
+    if dist.is_initialized():
+        dist.destroy_process_group()
+    pg = dist.init_process_group()
+    yield pg
+    if dist.is_initialized():
+        dist.destroy_process_group()
+
+
+class TestBilinearParity:
+    def test_matches_numpy_resampler_on_identical_boxes(self, rng):
+        """The jax resampler IS the host resampler (same half-pixel math):
+        identical boxes -> identical pixels."""
+        x = rng.uniform(0, 1, (4, 37, 41, 3)).astype(np.float32)
+        top = rng.uniform(0, 5, 4).astype(np.float32)
+        left = rng.uniform(0, 7, 4).astype(np.float32)
+        ch = rng.uniform(20, 30, 4).astype(np.float32)
+        cw = rng.uniform(20, 30, 4).astype(np.float32)
+        want = _bilinear_crop_resize_numpy(x, top, left, ch, cw, (16, 16))
+        got = bilinear_crop_resize(jnp.asarray(x), jnp.asarray(top),
+                                   jnp.asarray(left), jnp.asarray(ch),
+                                   jnp.asarray(cw), (16, 16))
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5,
+                                   atol=1e-5)
+
+
+class TestDeviceAugment:
+    def test_identity_config_equals_host_normalize(self, rng):
+        """pad_crop with padding=0 and size==input forces offset 0: the
+        device pipeline must reduce to exactly ToFloat+Normalize."""
+        x8 = rng.integers(0, 256, (3, 32, 32, 3)).astype(np.uint8)
+        aug = DeviceAugment.cifar10(32, padding=0, flip_p=0.0)
+        got = np.asarray(aug(jnp.asarray(x8), jax.random.key(0)))
+        norm = transforms.Normalize(transforms.CIFAR10_MEAN,
+                                    transforms.CIFAR10_STD)
+        want = norm(x8.astype(np.float32) / 255.0)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_forced_flip_mirrors(self, rng):
+        x8 = rng.integers(0, 256, (2, 8, 8, 3)).astype(np.uint8)
+        plain = DeviceAugment.cifar10(8, padding=0, flip_p=0.0)
+        flip = DeviceAugment.cifar10(8, padding=0, flip_p=1.0)
+        a = np.asarray(plain(jnp.asarray(x8), jax.random.key(1)))
+        b = np.asarray(flip(jnp.asarray(x8), jax.random.key(1)))
+        np.testing.assert_allclose(b, a[:, :, ::-1, :], rtol=1e-6)
+
+    def test_uint8_and_unit_float_agree(self, rng):
+        x8 = rng.integers(0, 256, (2, 24, 24, 3)).astype(np.uint8)
+        xf = x8.astype(np.float32) / 255.0
+        aug = DeviceAugment.imagenet(16)
+        a = np.asarray(aug(jnp.asarray(x8), jax.random.key(7)))
+        b = np.asarray(aug(jnp.asarray(xf), jax.random.key(7)))
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+    def test_resized_crop_shape_determinism_and_key_sensitivity(self, rng):
+        x8 = rng.integers(0, 256, (4, 48, 48, 3)).astype(np.uint8)
+        aug = DeviceAugment.imagenet(24, dtype=jnp.bfloat16)
+        a = aug(jnp.asarray(x8), jax.random.key(3))
+        assert a.shape == (4, 24, 24, 3) and a.dtype == jnp.bfloat16
+        b = aug(jnp.asarray(x8), jax.random.key(3))
+        np.testing.assert_array_equal(np.asarray(a, np.float32),
+                                      np.asarray(b, np.float32))
+        c = aug(jnp.asarray(x8), jax.random.key(4))
+        assert not np.array_equal(np.asarray(a, np.float32),
+                                  np.asarray(c, np.float32))
+
+    def test_pad_crop_windows_are_real_crops(self, rng):
+        """Every pad_crop output (flip off) must equal SOME integer window
+        of the zero-padded normalized input."""
+        x8 = rng.integers(0, 256, (3, 8, 8, 1)).astype(np.uint8)
+        aug = DeviceAugment(8, mode="pad_crop", padding=2, flip_p=0.0,
+                            mean=(0.0,), std=(1.0,))
+        got = np.asarray(aug(jnp.asarray(x8), jax.random.key(9)))
+        padded = np.pad(x8.astype(np.float32) / 255.0,
+                        ((0, 0), (2, 2), (2, 2), (0, 0)))
+        for i in range(3):
+            found = any(
+                np.allclose(got[i], padded[i, t:t + 8, l:l + 8], atol=1e-6)
+                for t in range(5) for l in range(5))
+            assert found, f"image {i}: no integer window matches"
+
+
+class TestDeviceLoaderAugment:
+    def test_end_to_end_raw_bytes_to_augmented_batches(self, pg):
+        ds = SyntheticImageNet(train=True, n=32, image_size=32,
+                               num_classes=10, transform=None)
+        host = DataLoader(ds, batch_size=16, shuffle=True, drop_last=True,
+                          to_float=False)
+        # raw path: host yields uint8
+        x, y = next(iter(host))
+        assert x.dtype == np.uint8
+        aug = DeviceAugment.imagenet(24)
+        dev = DeviceLoader(host, group=pg, augment=aug, augment_seed=5)
+        batches = [(np.asarray(x), np.asarray(y)) for x, y in dev]
+        assert len(batches) == 2
+        assert batches[0][0].shape == (16, 24, 24, 3)
+        assert batches[0][0].dtype == np.float32
+        # same epoch -> same stream; new epoch -> new augmentation draws
+        again = [np.asarray(x) for x, _ in dev]
+        np.testing.assert_array_equal(batches[0][0], again[0])
+        dev.set_epoch(1)
+        ep1 = [np.asarray(x) for x, _ in dev]
+        assert not np.array_equal(batches[0][0], ep1[0])

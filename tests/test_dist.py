@@ -46,6 +46,9 @@ class TestLifecycle:
         dist.destroy_process_group()
         pg = dist.init_process_group(backend="nccl")  # → tpu (runs on forced cpu)
         assert pg.size() >= 1
+        dist.destroy_process_group()
+        pg = dist.init_process_group(backend="mpi")  # → tpu (ref README:133)
+        assert dist.get_backend(pg) == "tpu"
 
     def test_unknown_backend_raises(self):
         with pytest.raises(ValueError, match="backend"):

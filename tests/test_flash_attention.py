@@ -13,6 +13,9 @@ import pytest
 from tpu_dist.nn.attention import scaled_dot_product_attention
 from tpu_dist.ops import flash_attention
 
+# compile-heavy file: excluded from the fast tier (`pytest -m "not slow"`)
+pytestmark = pytest.mark.slow
+
 
 def _rand_qkv(rng, b, tq, tk, h, d, dtype=jnp.float32):
     q = jnp.asarray(rng.standard_normal((b, tq, h, d)), dtype)

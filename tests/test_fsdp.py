@@ -12,6 +12,9 @@ from tpu_dist import nn, optim
 from tpu_dist.models import TransformerLM
 from tpu_dist.parallel import fsdp_shard, fsdp_specs, make_gspmd_train_step
 
+# compile-heavy file: excluded from the fast tier (`pytest -m "not slow"`)
+pytestmark = pytest.mark.slow
+
 VOCAB, DIM, T = 33, 64, 16
 
 

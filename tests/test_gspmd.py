@@ -11,6 +11,9 @@ from tpu_dist.models import TransformerLM
 from tpu_dist.parallel.gspmd import (PartitionRules, TRANSFORMER_TP_RULES,
                                      make_gspmd_train_step, shard_pytree)
 
+# compile-heavy file: excluded from the fast tier (`pytest -m "not slow"`)
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def mesh2d():

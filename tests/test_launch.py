@@ -14,7 +14,8 @@ from tpu_dist.launch import (ProcessExitedException, ProcessRaisedException,
                              spawn)
 from tpu_dist.launch.cli import build_parser, main
 
-pytestmark = pytest.mark.multiprocess
+# spawns real OS processes per test: slow tier
+pytestmark = [pytest.mark.multiprocess, pytest.mark.slow]
 
 
 # -- spawn helpers must be module-level (picklable) ---------------------------

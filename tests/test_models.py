@@ -13,6 +13,9 @@ import jax
 import jax.numpy as jnp
 
 from tpu_dist.models import ConvNet, resnet18, resnet50
+# compile-heavy file: excluded from the fast tier (`pytest -m "not slow"`)
+pytestmark = pytest.mark.slow
+
 
 
 def n_params(params):

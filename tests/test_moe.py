@@ -16,6 +16,9 @@ from tpu_dist.models import TransformerLM
 from tpu_dist.parallel import (MOE_EP_RULES, make_gspmd_train_step,
                                shard_pytree)
 
+# compile-heavy file: excluded from the fast tier (`pytest -m "not slow"`)
+pytestmark = pytest.mark.slow
+
 DIM, E = 8, 4
 
 

@@ -16,6 +16,9 @@ import jax.numpy as jnp
 from tpu_dist import nn
 from tpu_dist.nn import functional as F
 
+# compile-heavy file: excluded from the fast tier (`pytest -m "not slow"`)
+pytestmark = pytest.mark.slow
+
 
 def to_nhwc(x_nchw: np.ndarray) -> np.ndarray:
     return np.transpose(x_nchw, (0, 2, 3, 1))

@@ -15,6 +15,9 @@ from tpu_dist import nn, optim
 from tpu_dist.models import TransformerLM
 from tpu_dist.parallel import PipelineParallel
 
+# compile-heavy file: excluded from the fast tier (`pytest -m "not slow"`)
+pytestmark = pytest.mark.slow
+
 VOCAB, DIM, DEPTH, HEADS, T = 31, 16, 8, 2, 12
 
 

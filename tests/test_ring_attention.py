@@ -10,6 +10,9 @@ from tpu_dist.nn.attention import scaled_dot_product_attention
 from tpu_dist.parallel.ring_attention import (ring_self_attention,
                                               ulysses_self_attention)
 
+# compile-heavy file: excluded from the fast tier (`pytest -m "not slow"`)
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def mesh():

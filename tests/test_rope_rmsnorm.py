@@ -13,6 +13,9 @@ import tpu_dist.dist as dist
 from tpu_dist import nn, optim
 from tpu_dist.models import TransformerLM
 from tpu_dist.nn import rotary_embed
+# compile-heavy file: excluded from the fast tier (`pytest -m "not slow"`)
+pytestmark = pytest.mark.slow
+
 
 VOCAB, DIM, T = 29, 32, 16
 

@@ -9,6 +9,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 from tpu_dist import nn, optim
 from tpu_dist.models import TransformerLM
 
+# compile-heavy file: excluded from the fast tier (`pytest -m "not slow"`)
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def mesh():

@@ -24,13 +24,15 @@ from .ops import (all_gather, all_reduce, all_to_all, broadcast, pmean,
 from .eager import (ReduceOp, all_gather_host, all_gather_object,
                     all_reduce_host, all_to_all_host, broadcast_host,
                     broadcast_object_list, gather_host, gather_object, recv,
-                    reduce_host, scatter_host, scatter_object_list, send)
+                    reduce_host, scatter_host, scatter_object_list, send,
+                    send_recv_device)
 
 __all__ = [
     "all_reduce", "all_gather", "reduce_scatter", "broadcast", "all_to_all",
     "ppermute", "psum", "pmean", "ring_all_reduce",
     "ReduceOp", "all_reduce_host", "all_gather_host", "broadcast_host",
     "reduce_host", "gather_host", "scatter_host", "send", "recv",
+    "send_recv_device",
     "all_gather_object", "gather_object", "broadcast_object_list",
     "scatter_object_list", "all_to_all_host",
 ]

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import io
 import pickle
+import weakref
 from typing import Any, List, Optional
 
 import jax
@@ -30,8 +31,9 @@ import numpy as np
 
 __all__ = ["ReduceOp", "all_reduce_host", "all_gather_host",
            "broadcast_host", "reduce_host", "gather_host", "scatter_host",
-           "send", "recv", "all_gather_object", "gather_object",
-           "broadcast_object_list", "scatter_object_list", "all_to_all_host"]
+           "send", "recv", "send_recv_device", "all_gather_object",
+           "gather_object", "broadcast_object_list", "scatter_object_list",
+           "all_to_all_host"]
 
 
 class ReduceOp:
@@ -326,7 +328,10 @@ def send(x, dst: int, group=None, tag: int = 0) -> None:
     """torch ``dist.send`` parity: deliver this process's array to process
     ``dst``.  Matched by program order per (src, dst, tag), like torch.
     Buffered through the store server, so send does not block on the
-    receiver."""
+    receiver.  Control-plane transport: host serialization over the TCP
+    store — for tensor p2p between devices of the SAME mesh use
+    :func:`send_recv_device` (one ppermute hop over ICI, never touches
+    the host)."""
     group = _default_group(group)
     me = group.rank
     if dst == me:
@@ -340,6 +345,52 @@ def send(x, dst: int, group=None, tag: int = 0) -> None:
     buf = io.BytesIO()
     np.save(buf, np.asarray(x), allow_pickle=False)
     store.set(_p2p_key(me, dst, tag, seq), buf.getvalue())
+
+
+# mesh (weak) -> {(axis, src, dst): jitted mover}; weak so compiled movers
+# die with their mesh across init/destroy process-group cycles
+_device_p2p_cache = weakref.WeakKeyDictionary()
+
+
+def send_recv_device(x, src: int, dst: int, group=None):
+    """Tensor p2p between two *devices of the same mesh*, on the data
+    plane: one jitted ``lax.ppermute`` hop over ICI — no host readback,
+    no store round-trip, no pickle (c10d ``send``/``recv`` semantics for
+    the in-mesh case; the store-backed :func:`send`/:func:`recv` remain
+    the cross-process/control path, see their docstrings).
+
+    ``x`` is sharded ``P(axis)`` over the group's mesh (row blocks, like
+    every data batch); returns the same array with device ``dst``'s block
+    REPLACED by device ``src``'s block, all other blocks untouched.  The
+    single-controller analogue of rank ``src`` sending its shard and rank
+    ``dst`` receiving it.  Jit-cached per (mesh, src, dst); reuses the
+    compiled program across calls and shapes via jax's own cache.
+    """
+    group = _default_group(group)
+    src, dst = int(src), int(dst)
+    n = group.size()
+    for name, r in (("src", src), ("dst", dst)):
+        if not 0 <= r < n:
+            raise ValueError(f"{name} {r} out of range (mesh size {n})")
+    if src == dst:
+        raise ValueError("send to self deadlocks (torch semantics)")
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    mesh, axis = group.mesh, group.axis_name
+    per_mesh = _device_p2p_cache.setdefault(mesh, {})
+    fn = per_mesh.get((axis, src, dst))
+    if fn is None:
+        def local(xs):
+            moved = lax.ppermute(xs, axis, perm=[(src, dst)])
+            return jnp.where(lax.axis_index(axis) == dst, moved, xs)
+
+        fn = jax.jit(jax.shard_map(local, mesh=mesh, in_specs=P(axis),
+                                   out_specs=P(axis)))
+        per_mesh[(axis, src, dst)] = fn
+    return fn(x)
 
 
 def recv(src: int, group=None, tag: int = 0) -> np.ndarray:

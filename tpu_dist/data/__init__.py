@@ -11,6 +11,7 @@ from .datasets import (ArrayImageDataset, CIFAR10, ConcatDataset, Dataset,
                        ImageFolder, MNIST, Subset, SyntheticImageNet,
                        TensorDataset, random_split,
                        synthetic_cifar10_arrays, synthetic_mnist_arrays)
+from .device_augment import DeviceAugment, bilinear_crop_resize
 from .loader import DataLoader, DeviceLoader, default_collate
 from .sampler import (BatchSampler, DistributedSampler, RandomSampler,
                       Sampler, SequentialSampler, SubsetRandomSampler,
@@ -23,6 +24,7 @@ __all__ = [
     "Subset", "ConcatDataset", "random_split",
     "synthetic_mnist_arrays", "synthetic_cifar10_arrays",
     "DataLoader", "DeviceLoader", "default_collate",
+    "DeviceAugment", "bilinear_crop_resize",
     "Sampler", "SequentialSampler", "RandomSampler", "BatchSampler",
     "DistributedSampler", "WeightedRandomSampler", "SubsetRandomSampler",
 ]

@@ -210,9 +210,16 @@ def random_split(dataset: Dataset, lengths, seed: int = 0):
 # ---------------------------------------------------------------------------
 
 def _synthetic_arrays(n: int, hw: Tuple[int, int], channels: int,
-                      num_classes: int, seed) -> Tuple[np.ndarray, np.ndarray]:
-    rng = np.random.default_rng(seed)
-    templates = rng.normal(128.0, 40.0, (num_classes, *hw, channels))
+                      num_classes: int, seed,
+                      split) -> Tuple[np.ndarray, np.ndarray]:
+    # class templates come from ``seed`` ALONE — train and test splits
+    # must share them, or train->test generalization is impossible by
+    # construction (a model can memorize train to ~zero loss and still
+    # score chance on test: different templates are a different task).
+    # Only the sample draws (targets, noise) depend on the split.
+    templates = np.random.default_rng(seed).normal(
+        128.0, 40.0, (num_classes, *hw, channels))
+    rng = np.random.default_rng((*seed, int(split)))
     targets = rng.integers(0, num_classes, n)
     noise = rng.standard_normal((n, *hw, channels), dtype=np.float32) * 32.0
     data = np.clip(templates[targets] + noise, 0, 255).astype(np.uint8)
@@ -220,17 +227,19 @@ def _synthetic_arrays(n: int, hw: Tuple[int, int], channels: int,
 
 
 def synthetic_mnist_arrays(train: bool, n: Optional[int] = None):
-    """Deterministic MNIST-shaped data: (n, 28, 28, 1) uint8 + int64 labels."""
+    """Deterministic MNIST-shaped data: (n, 28, 28, 1) uint8 + int64 labels.
+    Train/test share class templates and differ in draws (held-out noise)."""
     if n is None:
         n = 60000 if train else 10000
-    return _synthetic_arrays(n, (28, 28), 1, 10, (0xDA7A, 0, int(train)))
+    return _synthetic_arrays(n, (28, 28), 1, 10, (0xDA7A, 0), int(train))
 
 
 def synthetic_cifar10_arrays(train: bool, n: Optional[int] = None):
-    """Deterministic CIFAR-shaped data: (n, 32, 32, 3) uint8 + int64 labels."""
+    """Deterministic CIFAR-shaped data: (n, 32, 32, 3) uint8 + int64 labels.
+    Train/test share class templates and differ in draws (held-out noise)."""
     if n is None:
         n = 50000 if train else 10000
-    return _synthetic_arrays(n, (32, 32), 3, 10, (0xDA7A, 1, int(train)))
+    return _synthetic_arrays(n, (32, 32), 3, 10, (0xDA7A, 1), int(train))
 
 
 # ---------------------------------------------------------------------------
@@ -490,11 +499,13 @@ class SyntheticImageNet(Dataset):
         self.num_classes = num_classes
         self.transform = transform
         self._seed = (seed, int(train))
-        rng = np.random.default_rng(self._seed)
-        self._templates = rng.normal(
+        # templates keyed by ``seed`` alone: train/test share classes and
+        # differ only in draws (see _synthetic_arrays)
+        self._templates = np.random.default_rng((seed,)).normal(
             128.0, 45.0, (num_classes, self._TPL, self._TPL, 3)
         ).astype(np.float32)
-        self.targets = rng.integers(0, num_classes, n).astype(np.int64)
+        self.targets = np.random.default_rng(self._seed).integers(
+            0, num_classes, n).astype(np.int64)
 
     def __len__(self):
         return self.n

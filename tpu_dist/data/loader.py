@@ -111,7 +111,7 @@ class DataLoader:
                  sampler: Optional[Sampler] = None, drop_last: bool = False,
                  num_workers: int = 0, pin_memory: bool = False,
                  seed: int = 0, prefetch_factor: int = 2,
-                 collate_fn=default_collate):
+                 collate_fn=default_collate, to_float: bool = True):
         if sampler is not None and shuffle:
             raise ValueError("sampler and shuffle are mutually exclusive")
         self.dataset = dataset
@@ -121,6 +121,19 @@ class DataLoader:
         self.seed = seed
         self.prefetch_factor = prefetch_factor
         self.collate_fn = collate_fn
+        # to_float=False keeps uint8 batches raw (no /255, no host
+        # transform) for on-device augmentation (DeviceAugment): the host
+        # does only index-gather + memcpy, and PCIe moves 4x fewer bytes.
+        # Only the vectorized gather path supports it — the per-item
+        # collate path runs the dataset's own transform inside __getitem__
+        # and cannot honor rawness, so refuse rather than silently float.
+        self.to_float = to_float
+        if not to_float and getattr(dataset, "gather", None) is None:
+            raise ValueError(
+                "to_float=False needs a dataset with a vectorized gather() "
+                "(ArrayImageDataset & friends); per-item datasets apply "
+                "their transform inside __getitem__ and would yield float "
+                "batches anyway")
         self.sampler = sampler if sampler is not None else (
             RandomSampler(dataset, seed=seed) if shuffle
             else SequentialSampler(dataset))
@@ -146,6 +159,8 @@ class DataLoader:
         gather = getattr(ds, "gather", None)
         if gather is not None:
             x, y = gather(np.asarray(indices, np.int64))
+            if not self.to_float:
+                return x, np.asarray(y)  # raw bytes; DeviceAugment path
             if x.dtype == np.uint8:  # torch ToTensor scaling, NHWC kept
                 x = x.astype(np.float32) / 255.0
             transform = getattr(ds, "transform", None)
@@ -186,12 +201,21 @@ class DeviceLoader:
     """
 
     def __init__(self, loader: DataLoader, group=None, prefetch: int = 2,
-                 local_shards: bool = True):
+                 local_shards: bool = True, augment=None,
+                 augment_seed: int = 0):
         import tpu_dist.dist as dist
         self.loader = loader
         self.group = group if group is not None else dist.get_default_group()
         self.prefetch = max(1, int(prefetch))
         self.local_shards = local_shards
+        # on-device augmentation (a DeviceAugment, or any callable
+        # ``(images, key) -> images``) applied to batch element 0 after
+        # placement — runs jitted on the mesh while the host slices the
+        # NEXT raw batch; keyed per (seed, epoch, batch) like the host
+        # transform rng (loader.py:_make_batch)
+        self.augment = augment
+        self.augment_seed = int(augment_seed)
+        self._epoch = 0
         if self.group.num_processes > 1 and local_shards:
             sampler = getattr(loader, "sampler", None)
             if not isinstance(sampler, DistributedSampler):
@@ -208,6 +232,7 @@ class DeviceLoader:
                     "pattern).", stacklevel=2)
 
     def set_epoch(self, epoch: int) -> None:
+        self._epoch = int(epoch)
         self.loader.set_epoch(epoch)
 
     def __len__(self):
@@ -219,6 +244,11 @@ class DeviceLoader:
 
         sharding = NamedSharding(self.group.mesh, P(self.group.axis_name))
         nproc = self.group.num_processes
+        aug = self.augment
+        if aug is not None:
+            base_key = jax.random.fold_in(
+                jax.random.key(self.augment_seed), self._epoch)
+        batch_idx = 0
 
         def place(a):
             a = np.ascontiguousarray(a)
@@ -229,7 +259,13 @@ class DeviceLoader:
             return jax.device_put(a, sharding)
 
         def stage(batch):
-            return tuple(place(a) for a in batch)
+            nonlocal batch_idx
+            placed = tuple(place(a) for a in batch)
+            if aug is not None:
+                key = jax.random.fold_in(base_key, batch_idx)
+                batch_idx += 1
+                placed = (aug(placed[0], key),) + placed[1:]
+            return placed
 
         it = iter(self.loader)
         buf: collections.deque = collections.deque()

@@ -212,7 +212,10 @@ def init_process_group(backend: str = "tpu",
         backend = backend.lower()
         if backend in ("gloo",):
             backend = "cpu"
-        if backend in ("nccl", "xla"):
+        # mpi: the reference name-checks it as an alternative accelerator
+        # backend (/root/reference/README.md:133); on TPU the accelerator
+        # data plane is XLA collectives either way
+        if backend in ("nccl", "xla", "mpi"):
             backend = "tpu"
         if backend not in ("tpu", "cpu"):
             raise ValueError(f"Unknown backend {backend!r}; use 'tpu' or 'cpu'")
@@ -262,8 +265,8 @@ def get_rank(group: Optional[ProcessGroup] = None) -> int:
 
 def get_backend(group: Optional[ProcessGroup] = None) -> str:
     """torch ``dist.get_backend`` parity: the group's normalized backend
-    string — ``'tpu'`` (XLA collectives; accepts the aliases nccl/xla at
-    init) or ``'cpu'`` (accepts gloo).  Subgroups inherit their parent's
+    string — ``'tpu'`` (XLA collectives; accepts the aliases nccl/xla/mpi
+    at init) or ``'cpu'`` (accepts gloo).  Subgroups inherit their parent's
     backend at creation (stamped in :func:`new_group`, so the answer
     stays right even after the default group is recycled)."""
     return getattr(_group(group), "_backend", None) or "tpu"

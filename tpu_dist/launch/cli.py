@@ -79,10 +79,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="append --local_rank=<n> to the script args "
                         "(classic torch.distributed.launch argv contract)")
     p.add_argument("--max_restarts", type=int, default=0,
-                   help="relaunch the whole (single-node) world up to N "
-                        "times after a worker failure (torchrun elastic "
-                        "parity); children see TPU_DIST_RESTART_COUNT and "
-                        "should resume from their latest checkpoint")
+                   help="single-node restart: relaunch the whole world up "
+                        "to N times after a worker failure (requires "
+                        "--nnodes=1 — multi-node restart needs cross-"
+                        "launcher agreement, not implemented); children "
+                        "see TPU_DIST_RESTART_COUNT and should resume "
+                        "from their latest checkpoint")
     p.add_argument("--standalone", action="store_true",
                    help="single-node mode with automatic rendezvous "
                         "(torchrun parity): forces --nnodes=1 "

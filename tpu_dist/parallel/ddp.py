@@ -414,28 +414,51 @@ class DistributedDataParallel:
         # takes only (params, model_state): feeding the whole TrainState
         # would re-lay-out ZeRO-1-sharded opt_state to replicated (an
         # all-gather of optimizer moments) on every eval batch
-        def local_eval(params, mstate, x, y):
+        def local_eval(params, mstate, x, y, n_valid):
             out = module.apply(params, x,
                                **({"state": mstate} if has_state else {}))
             if has_state:
                 out, _ = out
-            local_mean = loss_fn(out, y)
-            # scored = labels the loss actually counts (ignore_index
-            # excluded) — exact even when padding lands unevenly across
-            # devices: loss_sum = sum over scored labels, not a mean of
-            # per-device means.  (For weight= losses the mean's denominator
-            # is the weight sum, so loss_sum is approximate there.)
+            # rows at global index >= n_valid are evaluate()'s batch
+            # padding; under P(axis) sharding device d holds the
+            # contiguous slice starting at d * rows_per_device
+            rows = y.shape[0]
+            gidx = lax.axis_index(axis) * rows + jnp.arange(rows)
+            row_keep = (gidx < n_valid).reshape(
+                (rows,) + (1,) * (y.ndim - 1))
             hit = out.argmax(-1) == y
             if ignore is not None:
-                keep = y != ignore
+                # scored = labels the loss actually counts (ignore_index
+                # excluded) — exact even when padding lands unevenly
+                # across devices: loss_sum = sum over scored labels, not
+                # a mean of per-device means.  Padding rows carry
+                # ignore_index labels, so row_keep only re-excludes them;
+                # it additionally guards a pathological loss_fn whose
+                # ignore_index the padding labels can't use.  (For
+                # weight= losses the mean's denominator is the weight
+                # sum, so loss_sum is approximate there.)
+                local_mean = loss_fn(out, y)
+                keep = (y != ignore) & row_keep
                 kept = keep.sum()
                 # mask the numerator too: if ignore_index is a valid class
                 # id (torch permits >= 0), argmax CAN equal it at ignored
                 # positions — unmasked, accuracy would exceed 1.0
                 hit = hit & keep
+                loss_sum = local_mean * kept
             else:
-                kept = jnp.asarray(y.size, jnp.int32)
-            loss_sum = lax.psum(local_mean * kept, axis)
+                # loss_fn has no ignore_index: it would score padding
+                # rows.  Recover exact per-row losses by running the
+                # black-box loss on batch-1 slices (a vmapped mean over
+                # one row IS that row's loss) and sum only valid rows,
+                # each weighted by its element count.
+                per_row = jax.vmap(
+                    lambda o, t: loss_fn(o[None], t[None]))(out, y)
+                elems = y[0].size if y.ndim > 1 else 1
+                keep_rows = row_keep.reshape(rows)
+                loss_sum = (per_row * keep_rows).sum() * elems
+                kept = keep_rows.sum() * elems
+                hit = hit & jnp.broadcast_to(row_keep, hit.shape)
+            loss_sum = lax.psum(loss_sum, axis)
             correct = lax.psum(hit.sum(), axis)
             scored = lax.psum(kept, axis)
             return {"loss": loss_sum / jnp.maximum(scored, 1),
@@ -443,7 +466,7 @@ class DistributedDataParallel:
                     "scored": scored}
 
         fn = jax.shard_map(local_eval, mesh=self.group.mesh,
-                           in_specs=(P(), P(), P(axis), P(axis)),
+                           in_specs=(P(), P(), P(axis), P(axis), P()),
                            out_specs=P())
         return jax.jit(fn)
 
@@ -494,12 +517,17 @@ class DistributedDataParallel:
             self._train_repeat_cache[num_steps] = fn
         return fn(state, x, y)
 
-    def eval_step(self, state: TrainState, x, y):
+    def eval_step(self, state: TrainState, x, y, n_valid=None):
+        """``n_valid`` = number of real (non-padding) leading rows in the
+        global batch; defaults to all rows."""
         if self.loss_fn is None:
             raise ValueError("eval_step requires loss_fn=")
         if self._eval_step is None:
             self._eval_step = self._build_eval_step()
-        return self._eval_step(state.params, state.model_state, x, y)
+        if n_valid is None:
+            n_valid = int(x.shape[0])
+        return self._eval_step(state.params, state.model_state, x, y,
+                               jnp.asarray(n_valid, jnp.int32))
 
     def evaluate(self, state: TrainState, loader) -> dict:
         """Drive :meth:`eval_step` over a loader of ``(x, y)`` batches;
@@ -515,13 +543,21 @@ class DistributedDataParallel:
         sequence models — batch-padding rows and data-inherent padding
         tokens are both excluded, from the loss, the accuracy denominator,
         and the count (a padded label can never count as correct: argmax is
-        in [0, C)).  Loss aggregates as sum-over-scored-labels /
-        total-scored — exact under any padding distribution.  Metrics
-        accumulate on device; the single host readback happens at the end
-        (per-step ``float()`` would serialize eval over the dispatch
-        latency).
+        in [0, C)).  Works for any loss_fn: with an ``ignore_index``
+        attribute padding rows carry that label and the loss skips them;
+        without one, padding rows carry label 0 and ``eval_step`` masks
+        them positionally via the true row count (exact per-row losses via
+        a vmapped batch-1 loss call).  Loss aggregates as
+        sum-over-scored-labels / total-scored — exact under any padding
+        distribution.  Metrics accumulate on device; the single host
+        readback happens at the end (per-step ``float()`` would serialize
+        eval over the dispatch latency).
         """
-        ignore = getattr(self.loss_fn, "ignore_index", -100)
+        ignore = getattr(self.loss_fn, "ignore_index", None)
+        # without ignore_index semantics, pad with a valid label (0): the
+        # padded rows are masked out positionally, and an arbitrary custom
+        # loss may index with the label (-100 would be out of range)
+        pad_label = 0 if ignore is None else ignore
         n_dev = self.group.size()
         pad_to = None
         total_loss = total_correct = total_scored = None
@@ -533,9 +569,9 @@ class DistributedDataParallel:
                 x = jnp.concatenate(
                     [x, jnp.zeros((pad_to - b,) + x.shape[1:], x.dtype)])
                 y = jnp.concatenate(
-                    [y, jnp.full((pad_to - b,) + y.shape[1:], ignore,
+                    [y, jnp.full((pad_to - b,) + y.shape[1:], pad_label,
                                  y.dtype)])
-            m = self.eval_step(state, x, y)
+            m = self.eval_step(state, x, y, n_valid=b)
             if total_loss is None:
                 total_loss = m["loss_sum"]
                 total_correct = m["correct"]
