@@ -19,22 +19,52 @@ from __future__ import annotations
 import time
 
 
-def _time_fn(fn, args, reps: int = 3, iters: int = 10) -> float:
-    """Min-of-reps seconds per call; tunnel-safe single readback."""
-    import jax.numpy as jnp
+def _time_fn(fn, args, reps: int = 5, long_k: int = 40,
+             short_k: int = 8) -> float:
+    """Scan-chunked min-of-reps seconds per call.
 
-    out = fn(*args)
-    float(jnp.sum(out[0] if isinstance(out, tuple) else out))  # compile+warm
-    times = []
-    for _ in range(reps):
+    Per-dispatch timing is a lie on the axon tunnel: the dispatch floor is
+    ~8-12 ms per call, which swamps a sub-ms kernel at seq 2048 (observed:
+    identical wall-clock at 2048 and 8192 — 16x the FLOPs).  So run ``k``
+    applications inside ONE jitted ``lax.scan`` with a threaded data
+    dependency (XLA cannot elide iterations), difference long-minus-short
+    chunks to cancel the constant dispatch+readback, min-of-reps to shed
+    contention spikes — the same methodology as every train-step row
+    (benchmarks/timing.py).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    q = args[0]
+
+    def chunk(n):
+        @jax.jit
+        def run(*xs):
+            def body(carry, _):
+                out = fn(xs[0] + carry, *xs[1:])
+                o = out[0] if isinstance(out, tuple) else out
+                return (o.reshape(-1)[0] * 0).astype(q.dtype), ()
+            c, _ = lax.scan(body, jnp.zeros((), q.dtype),
+                            None, length=n)
+            return c
+        return run
+
+    run_long, run_short = chunk(long_k), chunk(short_k)
+
+    def t(f):
         t0 = time.perf_counter()
-        acc = None
-        for _ in range(iters):
-            out = fn(*args)
-            acc = out[0] if isinstance(out, tuple) else out
-        float(jnp.sum(acc))
-        times.append((time.perf_counter() - t0) / iters)
-    return min(times)
+        float(f(*args))  # scalar readback syncs
+        return time.perf_counter() - t0
+
+    for f in (run_long, run_short):  # compile + warm
+        t(f)
+    d_long = min(t(run_long) for _ in range(reps))
+    d_short = min(t(run_short) for _ in range(reps))
+    diff = (d_long - d_short) / (long_k - short_k)
+    if diff <= 0:  # contention crossed the minima; gross long is safe
+        diff = d_long / long_k
+    return diff
 
 
 def _time_stock_kernel(q, k, v, flops_fwd):

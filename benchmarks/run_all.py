@@ -112,6 +112,10 @@ def main() -> None:
         print(json.dumps(r))
         results.append(r)
 
+    # entries recorded by other tools (e.g. test_tier_timings) survive
+    ours = {r.get("metric") for r in results}
+    results += [e for m, e in previous.items() if m not in ours]
+
     with open(out, "w") as f:
         json.dump(results, f, indent=1)
     print(f"wrote {out}")

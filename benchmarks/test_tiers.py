@@ -1,0 +1,60 @@
+"""Time the two pytest tiers and record them in BENCH_EXTENDED.json.
+
+VERDICT r2 weak-#5: the marker tiering must actually deliver a fast inner
+loop, and the timings must be recorded somewhere a reader can check.
+Run on an OTHERWISE IDLE host — this box has one core, so any concurrent
+chip job starves pytest and the wall-clock lies (observed 13 min -> 21 min
+under contention).
+
+Usage: python -m benchmarks.test_tiers [--fast-only]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_tier(args: list) -> dict:
+    t0 = time.perf_counter()
+    p = subprocess.run([sys.executable, "-m", "pytest", "tests/", "-q",
+                        "-p", "no:cacheprovider", *args],
+                       cwd=_REPO, capture_output=True, text=True)
+    wall = time.perf_counter() - t0
+    tail = (p.stdout.strip().splitlines() or [""])[-1]
+    return {"wall_sec": round(wall, 1), "exit": p.returncode,
+            "summary": tail[-160:]}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--fast-only", action="store_true")
+    args = ap.parse_args()
+
+    entry = {"metric": "test_tier_timings",
+             "host_cores": os.cpu_count() or 1,
+             "fast_tier": _run_tier(["-m", "not slow"])}
+    if not args.fast_only:
+        entry["full_suite"] = _run_tier([])
+
+    out = os.path.join(_REPO, "BENCH_EXTENDED.json")
+    rows = []
+    if os.path.exists(out):
+        with open(out) as f:
+            rows = json.load(f)
+    rows = [e for e in rows if e.get("metric") != "test_tier_timings"]
+    rows.append(entry)
+    with open(out, "w") as f:
+        json.dump(rows, f, indent=1)
+    print(json.dumps(entry))
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, _REPO)
+    main()

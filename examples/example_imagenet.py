@@ -5,9 +5,12 @@ reference's CIFAR script (/root/reference/example_mp.py:50,74-90).
 Workload shape: ResNet-50, 224x224x3 inputs, 1000 classes, per-replica batch
 128, SGD lr 0.1 (linear-scaling rule base), momentum .9, wd 1e-4; mixed
 precision (bf16 compute, f32 master weights) on by default — the TPU recipe.
-Input pipeline: RandomResizedCrop(224) + HorizontalFlip + Normalize on the
-multi-worker vectorized loader, double-buffered onto the mesh through
-DeviceLoader.
+Input pipeline: RandomResizedCrop(224) + HorizontalFlip + Normalize —
+by default as ONE jitted XLA program on device (data/device_augment.py;
+the host only slices raw uint8, the sole way a few-core TPU host keeps a
+ResNet-50 fed), double-buffered onto the mesh through DeviceLoader.
+``--host-augment`` restores the reference's numpy-on-host-workers recipe
+(/root/reference/example_mp.py:74-80 idiom).
 
 Data: ``--imagefolder PATH`` trains from an on-disk
 ``root/<class>/<img>`` tree (real ImageNet layout); default is the
@@ -39,6 +42,14 @@ def main():
     parser.add_argument("--num-classes", default=1000, type=int)
     parser.add_argument("--synthetic-size", default=2048, type=int)
     parser.add_argument("--num-workers", default=4, type=int)
+    parser.add_argument("--host-augment", action="store_true",
+                        help="torchvision-style numpy augmentation on host "
+                             "workers (the reference recipe). Default is "
+                             "on-DEVICE augmentation: the host ships raw "
+                             "uint8 and crop/flip/normalize runs as one "
+                             "jitted XLA program — the only way a few-core "
+                             "TPU host feeds a ResNet-50 (BENCH_EXTENDED "
+                             "input-pipeline row)")
     parser.add_argument("--no-bf16", action="store_true",
                         help="full f32 compute (default is mixed bf16)")
     parser.add_argument("--sync-bn", action="store_true")
@@ -67,21 +78,24 @@ def main():
     print(f"[init] == process rank {rank}, "
           f"{dist.get_world_size()} device replicas ==")
 
-    aug = transforms.Compose([
-        transforms.RandomResizedCrop(args.image_size),
-        transforms.RandomHorizontalFlip(),
-        transforms.Normalize(transforms.IMAGENET_MEAN,
-                             transforms.IMAGENET_STD),
-    ])
+    host_aug = None
+    if args.host_augment:
+        host_aug = transforms.Compose([
+            transforms.RandomResizedCrop(args.image_size),
+            transforms.RandomHorizontalFlip(),
+            transforms.Normalize(transforms.IMAGENET_MEAN,
+                                 transforms.IMAGENET_STD),
+        ])
     if args.imagefolder:
-        ds = ImageFolder(args.imagefolder, transform=aug,
+        ds = ImageFolder(args.imagefolder, transform=host_aug,
                          sample_size=(args.image_size + 32,
                                       args.image_size + 32))
         num_classes = len(ds.classes)
     else:
         ds = SyntheticImageNet(train=True, n=args.synthetic_size,
                                image_size=args.image_size,
-                               num_classes=args.num_classes, transform=aug)
+                               num_classes=args.num_classes,
+                               transform=host_aug)
         num_classes = args.num_classes
 
     ddp = DistributedDataParallel(
@@ -95,11 +109,18 @@ def main():
     world_batch = args.batch_size * dist.get_world_size()
     sampler = DistributedSampler(ds, num_replicas=dist.get_num_processes(),
                                  rank=rank, shuffle=True)
+    dev_aug = None
+    if not args.host_augment:
+        from tpu_dist.data import DeviceAugment
+        dev_aug = DeviceAugment.imagenet(
+            args.image_size,
+            dtype=jnp.float32 if args.no_bf16 else jnp.bfloat16)
     loader = DeviceLoader(
         DataLoader(ds, batch_size=world_batch // dist.get_num_processes(),
                    sampler=sampler, drop_last=True,
-                   num_workers=args.num_workers),
-        group=pg)
+                   num_workers=args.num_workers,
+                   to_float=args.host_augment),
+        group=pg, augment=dev_aug)
 
     total_step = len(loader.loader)
     start = datetime.now()
