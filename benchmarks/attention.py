@@ -106,8 +106,12 @@ def run(b: int = 4, h: int = 8, d: int = 64) -> dict:
 
     rng = np.random.default_rng(0)
     rows = []
-    for t, both in ((2048, True), (8192, False)):
-        q, k, v = (jnp.asarray(rng.standard_normal((b, t, h, d)),
+    # (seq, dense-comparison?, batch): 32k runs batch 1 — the O(T)-memory
+    # long-context row where a materialized (T, T) score matrix would be
+    # 4 GB of f32 per head; flash only
+    for t, both, bt in ((2048, True, b), (8192, False, b),
+                        (32768, False, 1)):
+        q, k, v = (jnp.asarray(rng.standard_normal((bt, t, h, d)),
                                jnp.bfloat16) for _ in range(3))
 
         def train_step(q, k, v, impl):
@@ -116,8 +120,10 @@ def run(b: int = 4, h: int = 8, d: int = 64) -> dict:
                 return jnp.sum(o.astype(jnp.float32) ** 2)
             return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
 
-        flops_fwd = 4 * b * h * t * t * d
+        flops_fwd = 4 * bt * h * t * t * d
         row = {"seq_len": t}
+        if bt != b:
+            row["batch"] = bt
         for impl in ("flash", "dense") if both else ("flash",):
             fwd = jax.jit(lambda q, k, v, i=impl: sdpa(
                 q, k, v, causal=True, impl=i))
@@ -133,10 +139,11 @@ def run(b: int = 4, h: int = 8, d: int = 64) -> dict:
         if both:
             row["flash_speedup_fwd_bwd"] = round(
                 row["dense"]["fwd_bwd_ms"] / row["flash"]["fwd_bwd_ms"], 3)
-        else:
-            # long-sequence row: compare against the stock JAX Pallas flash
-            # kernel (the README's ~2x fwd / ~4x fwd+bwd claim), which uses
-            # (B, H, T, D) layout
+        elif t == 8192:
+            # compare against the stock JAX Pallas flash kernel at the
+            # mid seq (the README's speedup claim); skipped at 32k, where
+            # the stock kernel's 5x-slower fwd+bwd makes the comparison
+            # chain minutes-long for no extra information
             stock = _time_stock_kernel(q, k, v, flops_fwd)
             if stock is not None:
                 row["stock_jax_kernel"] = stock

@@ -12,7 +12,9 @@ is proven separately on the 8-device dryrun (__graft_entry__._dryrun_dp_ep,
 what a chip actually sustains running the MoE compute graph (router +
 dispatch + 2-of-8 expert FFNs + combine) through the standard DDP bf16
 fused step, timed with the same scan-differenced methodology as the dense
-row.
+row.  Per-chip batch 2 (not the dense row's 8): the GShard dispatch/combine
+temps scale with tokens x experts and OOM 16G HBM at batch 8 (measured
+29.8G) — tokens/sec is reported per chip either way.
 """
 
 from __future__ import annotations
@@ -20,7 +22,7 @@ from __future__ import annotations
 import json
 
 
-def run(batch: int = 8, seq_len: int = 2048, dim: int = 768,
+def run(batch: int = 2, seq_len: int = 2048, dim: int = 768,
         depth: int = 12, heads: int = 12, vocab: int = 32768,
         experts: int = 8, steps: int = 20, reps: int = 3) -> dict:
     import jax

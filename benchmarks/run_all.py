@@ -93,6 +93,7 @@ def main() -> None:
         "attention": "flash_attention_causal_bf16",
         "transformer_lm": "transformer_lm_bf16_train_tokens_per_sec_per_chip",
         "moe_lm": "transformer_moe_lm_bf16_train_tokens_per_sec_per_chip",
+        "lm_long": "transformer_lm_long_context_8k_bf16_tokens_per_sec_per_chip",
     }
     results = []
     for name, fn in (("resnet_cifar", resnet_cifar.run),
@@ -100,7 +101,8 @@ def main() -> None:
                      ("input_pipeline", input_pipeline.run),
                      ("attention", attention.run),
                      ("transformer_lm", transformer_lm.run),
-                     ("moe_lm", moe_lm.run)):
+                     ("moe_lm", moe_lm.run),
+                     ("lm_long", transformer_lm.run_long)):
         try:
             r = fn()
         except Exception as e:  # record the failure, keep the rest running

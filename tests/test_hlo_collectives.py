@@ -205,3 +205,80 @@ class TestGSPMDTPCollectives:
         # bounded: depth-2 TP transformer fwd+bwd+update stays within a
         # few dozen collectives total
         assert total <= 64, c
+
+
+class TestFSDPCollectives:
+    def test_zero3_allgather_and_reduce_scatter(self):
+        """ZeRO-3 (params sharded over 'data'): XLA's SPMD partitioner
+        must all-gather shards for compute and reduce-scatter grads back —
+        both present, and the total stays O(layers), bounded."""
+        from tpu_dist.models import TransformerLM
+        from tpu_dist.parallel import fsdp_shard, make_gspmd_train_step
+
+        if dist.is_initialized():
+            dist.destroy_process_group()
+        dist.init_process_group(backend="cpu")
+        try:
+            pg = dist.get_default_group()
+            vocab = 33
+            model = TransformerLM(vocab_size=vocab, dim=64, depth=2,
+                                  num_heads=4, max_seq_len=16)
+            ce = nn.CrossEntropyLoss()
+
+            def loss_fn(lg, y):
+                return ce(lg.reshape(-1, vocab), y.reshape(-1))
+
+            opt = optim.SGD(lr=0.1, momentum=0.9)
+            params = fsdp_shard(model.init(jax.random.key(0)), pg.mesh,
+                                min_size=256)
+            opt_state = {"momentum": fsdp_shard(
+                jax.tree.map(jnp.zeros_like, params), pg.mesh,
+                min_size=256)}
+            step = make_gspmd_train_step(model, loss_fn, opt, donate=False)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            bsh = NamedSharding(pg.mesh, P(pg.axis_name, None))
+            x = jax.device_put(jnp.zeros((16, 16), jnp.int32), bsh)
+            y = jax.device_put(jnp.zeros((16, 16), jnp.int32), bsh)
+            hlo = step.lower(params, opt_state, x, y).compile().as_text()
+            c = collective_counts(hlo)
+            assert c["all-gather"] >= 1, c
+            assert c["reduce-scatter"] + c["all-reduce"] >= 1, c
+            # observed 70 on the CPU SPMD partitioner for depth 2 (it
+            # re-gathers per use and emits resharding collectives);
+            # bounded = not O(parameters): 8 leaf tensors/layer x fwd+bwd
+            # would be ~128 at one collective per leaf-use
+            assert sum(c.values()) <= 128, c
+        finally:
+            dist.destroy_process_group()
+
+
+class TestRingAttentionCollectives:
+    def test_ring_rotation_is_collective_permute(self):
+        """Ring attention's KV rotation lowers to collective-permute (the
+        ICI neighbor hop), not all-gather — the O(T/n)-memory property
+        depends on never materializing the full KV."""
+        from jax.sharding import PartitionSpec as P
+        from tpu_dist.parallel.ring_attention import ring_self_attention
+
+        if dist.is_initialized():
+            dist.destroy_process_group()
+        dist.init_process_group(backend="cpu", axis_names=("seq",))
+        try:
+            pg = dist.get_default_group()
+            B, T, H, D = 2, 64, 2, 8
+
+            def local(q, k, v):
+                return ring_self_attention(q, k, v, axis_name="seq",
+                                           causal=False)
+
+            fn = jax.jit(jax.shard_map(
+                local, mesh=pg.mesh,
+                in_specs=(P(None, "seq"), P(None, "seq"), P(None, "seq")),
+                out_specs=P(None, "seq")))
+            q = jnp.zeros((B, T, H, D), jnp.float32)
+            hlo = fn.lower(q, q, q).compile().as_text()
+            c = collective_counts(hlo)
+            assert c["collective-permute"] >= 1, c
+            assert c["all-gather"] == 0, c
+        finally:
+            dist.destroy_process_group()

@@ -191,3 +191,38 @@ def test_moe_validation():
         nn.MoELayer(DIM, 4, top_k=5)
     with pytest.raises(ValueError, match="moe_every"):
         TransformerLM(vocab_size=16, dim=DIM, num_experts=4, moe_every=0)
+
+
+class TestMoeUnderDDPBf16:
+    def test_train_repeat_carries_f32_state(self):
+        """MoE TransformerLM through the DDP wrapper with bf16 compute:
+        activation-derived state (aux_loss) must cast back to the f32
+        state master or the scan carry dtype flips (regression: the
+        moe_lm bench's train_repeat failed with a carry type mismatch)."""
+        import jax.numpy as jnp
+        import tpu_dist.dist as dist
+        from tpu_dist.parallel import DistributedDataParallel
+
+        if dist.is_initialized():
+            dist.destroy_process_group()
+        pg = dist.init_process_group()
+        try:
+            model = TransformerLM(vocab_size=32, dim=16, depth=1,
+                                  num_heads=2, max_seq_len=8,
+                                  num_experts=4)
+            ddp = DistributedDataParallel(
+                model, optimizer=optim.SGD(lr=0.1),
+                loss_fn=nn.CrossEntropyLoss(), group=pg, donate=False,
+                compute_dtype=jnp.bfloat16)
+            st = ddp.init(seed=0)
+            rng = np.random.default_rng(0)
+            B = max(8, pg.size())
+            x = jnp.asarray(rng.integers(0, 32, (B, 8)))
+            y = jnp.asarray(rng.integers(0, 32, (B, 8)))
+            st2, m = ddp.train_repeat(st, x, y, 3)
+            assert m["loss"].shape == (3,)
+            assert all(v.dtype == o.dtype for v, o in zip(
+                jax.tree.leaves(st2.model_state),
+                jax.tree.leaves(st.model_state)))
+        finally:
+            dist.destroy_process_group()

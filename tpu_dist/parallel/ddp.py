@@ -274,6 +274,14 @@ class DistributedDataParallel:
                     if has_state:
                         out, new_ms = module.apply(p, xc, state=ms,
                                                    training=True, rng=key)
+                        # keep the f32 state master under bf16 compute:
+                        # purely activation-derived leaves (MoE aux_loss)
+                        # come back in compute_dtype, which would flip the
+                        # scan carry's dtype (BatchNorm stats hide this —
+                        # blending with the f32 running value re-promotes)
+                        if cdtype is not None:
+                            new_ms = jax.tree.map(
+                                lambda n, o: n.astype(o.dtype), new_ms, ms)
                     else:
                         out = module.apply(p, xc, training=True, rng=key)
                         new_ms = ms
