@@ -5,6 +5,7 @@ import subprocess
 import sys
 import tempfile
 import textwrap
+import time
 
 import pytest
 
@@ -184,9 +185,11 @@ class TestElasticRestart:
         script.write_text("import sys; sys.exit(5)\n")
         assert main(["--nproc_per_node=1", "--no_store", str(script)]) == 5
 
-    def test_multi_node_rejected(self):
+    def test_multi_node_without_store_rejected(self):
+        """Multi-node elastic rides the store for the restart agreement;
+        --no_store cannot coordinate and is refused up front."""
         assert main(["--nnodes=2", "--node_rank=0", "--max_restarts=1",
-                     "x.py"]) == 2
+                     "--no_store", "x.py"]) == 2
 
     def test_negative_rejected(self):
         assert main(["--max_restarts=-1", "x.py"]) == 2
@@ -215,3 +218,107 @@ class TestStandaloneAndRunAlias:
         assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
         assert "standalone rank 0 backend cpu" in r.stdout
         assert "standalone rank 1 backend cpu" in r.stdout
+
+
+class TestMultiNodeElastic:
+    """--max_restarts across --nnodes>1: launchers agree on each restart
+    round through the control-plane store (the torchrun-elastic analogue;
+    previously rejected as single-node-only)."""
+
+    def test_two_launchers_agree_and_restart(self, tmp_path):
+        import socket
+        import subprocess as sp
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            store_port = s.getsockname()[1]
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            master_port = s.getsockname()[1]
+
+        script = tmp_path / "flaky.py"
+        script.write_text(textwrap.dedent(f"""
+            import os, sys, time
+            rnd = os.environ["TPU_DIST_RESTART_COUNT"]
+            rank = os.environ["RANK"]
+            open(os.path.join({str(tmp_path)!r},
+                              f"round{{rnd}}_rank{{rank}}"), "w").close()
+            if rnd == "0" and rank == "1":
+                sys.exit(3)       # node 1's worker fails in round 0
+            time.sleep(1.5)       # node 0's worker outlives the failure:
+                                  # it must be stopped by the remote-fail
+                                  # poll, not by natural exit
+        """))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+        def launcher(node_rank):
+            return sp.Popen(
+                [sys.executable, "-m", "tpu_dist.launch",
+                 "--nproc_per_node=1", "--nnodes=2",
+                 f"--node_rank={node_rank}",
+                 "--master_addr=127.0.0.1",
+                 f"--master_port={master_port}",
+                 f"--store_port={store_port}",
+                 "--max_restarts=1", "--elastic_timeout=60",
+                 str(script)],
+                env=env, stderr=sp.PIPE, text=True)
+
+        l0 = launcher(0)
+        time.sleep(0.5)  # node 0 must host the store first
+        l1 = launcher(1)
+        out0 = l0.communicate(timeout=120)[1]
+        out1 = l1.communicate(timeout=120)[1]
+        assert l0.returncode == 0, out0
+        assert l1.returncode == 0, out1
+        for rnd in (0, 1):
+            for rank in (0, 1):
+                assert (tmp_path / f"round{rnd}_rank{rank}").exists(), \
+                    (rnd, rank, out0, out1)
+        assert "agreed restart 1/1" in out0 + out1
+
+    def test_exhausted_restarts_fail_everywhere(self, tmp_path):
+        import socket
+        import subprocess as sp
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            store_port = s.getsockname()[1]
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            master_port = s.getsockname()[1]
+
+        script = tmp_path / "always_fail.py"
+        script.write_text(textwrap.dedent("""
+            import os, sys, time
+            if os.environ["RANK"] == "1":
+                sys.exit(9)
+            time.sleep(1.5)
+        """))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+        def launcher(node_rank):
+            return sp.Popen(
+                [sys.executable, "-m", "tpu_dist.launch",
+                 "--nproc_per_node=1", "--nnodes=2",
+                 f"--node_rank={node_rank}",
+                 "--master_addr=127.0.0.1", f"--master_port={master_port}",
+                 f"--store_port={store_port}",
+                 "--max_restarts=1", "--elastic_timeout=60",
+                 str(script)],
+                env=env, stderr=sp.PIPE, text=True)
+
+        l0 = launcher(0)
+        time.sleep(0.5)
+        l1 = launcher(1)
+        out0 = l0.communicate(timeout=120)[1]
+        out1 = l1.communicate(timeout=120)[1]
+        # both launchers give up after the agreed restart budget: nonzero
+        # exit on every node, not a hang and not a partial success
+        assert l0.returncode != 0, out0
+        assert l1.returncode != 0, out1
+        # ... and it really was the budget, reached through one agreed
+        # restart — not an agreement timeout dressed up as failure
+        assert "agreed restart 1/1" in out0 + out1, (out0, out1)
+        assert "elastic agreement failed" not in out0 + out1, (out0, out1)

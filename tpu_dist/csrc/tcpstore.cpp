@@ -116,12 +116,18 @@ struct Server {
     if (accept_thread.joinable()) accept_thread.join();
     // Wake handler threads blocked in recv on idle client connections —
     // without this, join() below deadlocks on any still-connected client.
+    // Then join WITHOUT holding handlers_mu: a handler's exit path locks
+    // it to deregister its fd (handle() epilogue), so joining under the
+    // mutex deadlocks whenever a client disconnects concurrently with
+    // stop — observed as an intermittent hang when two elastic launchers
+    // tear down at the same moment.
+    std::vector<std::thread> to_join;
     {
       std::lock_guard<std::mutex> g(handlers_mu);
       for (int fd : client_fds) ::shutdown(fd, SHUT_RDWR);
+      to_join.swap(handlers);
     }
-    std::lock_guard<std::mutex> g(handlers_mu);
-    for (auto& t : handlers)
+    for (auto& t : to_join)
       if (t.joinable()) t.join();
   }
 

@@ -79,12 +79,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="append --local_rank=<n> to the script args "
                         "(classic torch.distributed.launch argv contract)")
     p.add_argument("--max_restarts", type=int, default=0,
-                   help="single-node restart: relaunch the whole world up "
-                        "to N times after a worker failure (requires "
-                        "--nnodes=1 — multi-node restart needs cross-"
-                        "launcher agreement, not implemented); children "
-                        "see TPU_DIST_RESTART_COUNT and should resume "
-                        "from their latest checkpoint")
+                   help="relaunch the whole world up to N times after a "
+                        "worker failure. Multi-node: the launchers agree "
+                        "on each restart round through the control-plane "
+                        "store (run every node with the SAME "
+                        "--max_restarts; needs the store, so not with "
+                        "--no_store). Children see TPU_DIST_RESTART_COUNT "
+                        "and should resume from their latest checkpoint")
+    p.add_argument("--elastic_timeout", type=float, default=120.0,
+                   help="seconds to wait for every launcher to join the "
+                        "restart agreement before giving up (multi-node "
+                        "--max_restarts only)")
     p.add_argument("--standalone", action="store_true",
                    help="single-node mode with automatic rendezvous "
                         "(torchrun parity): forces --nnodes=1 "
@@ -148,6 +153,12 @@ def _setup_store(args):
                 # generous timeout)
                 store = TCPStore(args.master_addr, port, timeout=120.0)
                 master_port = int(store.get("tpu_dist/master_port"))
+            elif args.max_restarts > 0 and args.nnodes > 1:
+                # multi-node elastic: the restart agreement rides the
+                # store from EVERY launcher, so connect even though the
+                # address is deterministic
+                store = TCPStore(args.master_addr, port, timeout=120.0)
+                master_port = args.master_port
             else:
                 # fixed port: the store address is deterministic, so hand it
                 # to the children without blocking this launcher on a
@@ -214,7 +225,7 @@ def _spawn_world(args, world_size: int, master_port: int,
 
 
 def _watch_world(args, procs: List[subprocess.Popen], store,
-                 world_size: int):
+                 world_size: int, rnd: int = 0):
     """Monitor one round until every rank exits → ``(exit_code,
     interrupted)``; ``interrupted`` distinguishes launcher Ctrl-C (never
     restarted) from a worker that happened to exit with code 130.
@@ -225,6 +236,12 @@ def _watch_world(args, procs: List[subprocess.Popen], store,
     notifier), so a child in rendezvous/teardown survives terminate() and
     would otherwise linger until the coordination-service heartbeat
     timeout (~100s); escalate to SIGKILL after a grace period.
+
+    Multi-node elastic (``--max_restarts`` with ``--nnodes>1``): a
+    launcher that sees a local worker die publishes the round's failure
+    key on the store; every launcher polls it (~0.5 s) and tears down its
+    own workers on sight, so the whole world stops together — the
+    restart *agreement* happens afterwards in :func:`_elastic_agree`.
     """
     kill_grace = 15.0
     exit_code = 0
@@ -232,6 +249,11 @@ def _watch_world(args, procs: List[subprocess.Popen], store,
     t0 = time.monotonic()
     kill_deadline = None
     liveness_reported = world_size <= 1 or store is None or args.node_rank != 0
+    elastic = (args.max_restarts > 0 and args.nnodes > 1
+               and store is not None)
+    fail_key = f"tpu_dist/elastic/fail/{rnd}"
+    last_remote_check = 0.0
+    remote_failed = False
     try:
         remaining = set(range(len(procs)))
         while remaining:
@@ -253,9 +275,29 @@ def _watch_world(args, procs: List[subprocess.Popen], store,
                 remaining.discard(i)
                 if rc != 0 and exit_code == 0:
                     exit_code = rc
+                    if elastic:
+                        try:
+                            store.set(fail_key,
+                                      str(args.node_rank).encode())
+                        except Exception:
+                            pass
                     for j in remaining:
                         procs[j].terminate()
                     kill_deadline = time.monotonic() + kill_grace
+            if (elastic and exit_code == 0 and not remote_failed
+                    and time.monotonic() - last_remote_check > 0.5):
+                last_remote_check = time.monotonic()
+                try:
+                    if store.check(fail_key):
+                        remote_failed = True
+                        sys.stderr.write(
+                            "[tpu_dist.launch] another node reported a "
+                            "worker failure; stopping local workers\n")
+                        for j in remaining:
+                            procs[j].terminate()
+                        kill_deadline = time.monotonic() + kill_grace
+                except Exception:
+                    pass
             if (kill_deadline is not None
                     and time.monotonic() > kill_deadline):
                 for j in remaining:
@@ -266,6 +308,8 @@ def _watch_world(args, procs: List[subprocess.Popen], store,
                     procs[next(iter(remaining))].wait(timeout=0.2)
                 except subprocess.TimeoutExpired:
                     pass
+        if remote_failed and exit_code == 0:
+            exit_code = 1  # this node restarts/exits with the group
     except KeyboardInterrupt:
         for p in procs:
             if p.poll() is None:
@@ -280,6 +324,100 @@ def _watch_world(args, procs: List[subprocess.Popen], store,
         exit_code = 130
         interrupted = True
     return exit_code, interrupted
+
+
+def _reset_round_state(store, world_size: int) -> None:
+    """Reset last round's control-plane state before a restart: liveness
+    marks AND the teardown-barrier arrival counter — a partial teardown
+    (one rank crashed mid-round) leaves the counter off-generation, which
+    would make the next round's first teardown caller sail through the
+    barrier early."""
+    for r in range(world_size):
+        try:
+            store.delete_key(f"tpu_dist/alive/{r}")
+        except Exception:
+            pass
+    try:
+        store.delete_key("__barrier__/teardown")
+    except Exception:
+        pass
+
+
+def _elastic_exit_sync(args, store, rnd: int) -> None:
+    """Final ack before launchers exit the elastic protocol: node 0 hosts
+    the store, so it must not return (and tear the server down) while a
+    peer is still polling the agreement counters — the peer would see a
+    ConnectionError instead of its own clean verdict."""
+    try:
+        key = f"tpu_dist/elastic/exit/{rnd}"
+        store.add(key, 1)
+        if args.node_rank == 0:
+            store.wait_value_ge(key, args.nnodes,
+                                timeout=min(15.0, args.elastic_timeout))
+    except Exception:
+        pass  # best effort: worst case is the peer's noisier error path
+
+
+def _elastic_agree(args, store, rnd: int, local_rc: int,
+                   negotiated_port: bool, master_port: int):
+    """Cross-launcher end-of-round agreement (multi-node elastic).
+
+    Returns ``("done", rc)``, ``("restart", new_master_port)``, or
+    ``("giveup", rc)``.  Protocol, all keys round-scoped so no cleanup
+    races between rounds (every launcher must run with the same
+    ``--max_restarts``):
+
+    1. every launcher adds itself to ``done/{rnd}`` once its local
+       workers have exited (success or failure alike);
+    2. waits until all ``--nnodes`` have arrived (bounded by
+       ``--elastic_timeout`` — a vanished peer machine must not hang the
+       group forever);
+    3. outcome = failure iff ``fail/{rnd}`` was published by anyone;
+    4. on restart: node 0 re-picks the coordinator port when it was
+       store-negotiated, resets liveness/teardown keys, then publishes
+       ``go/{rnd}`` — the other launchers respawn only after reading it
+       (workers must not race the control-plane reset).
+    """
+    prefix = "tpu_dist/elastic"
+    nnodes = args.nnodes
+    try:
+        if local_rc != 0:
+            # re-publish before arriving at the done barrier: the watch
+            # loop's best-effort publish may have been swallowed by a
+            # transient store error, and peers must not read this round
+            # as a success
+            store.set(f"{prefix}/fail/{rnd}", str(args.node_rank).encode())
+        store.add(f"{prefix}/done/{rnd}", 1)
+        store.wait_value_ge(f"{prefix}/done/{rnd}", nnodes,
+                            timeout=args.elastic_timeout)
+        # this node's own verdict counts even if no publish ever landed
+        failed = local_rc != 0 or store.check(f"{prefix}/fail/{rnd}")
+    except Exception as e:
+        sys.stderr.write(f"[tpu_dist.launch] elastic agreement failed "
+                         f"({e!r}); giving up\n")
+        return ("giveup", local_rc or 1)
+    if not failed:
+        _elastic_exit_sync(args, store, rnd)
+        return ("done", 0)
+    if rnd >= args.max_restarts:
+        _elastic_exit_sync(args, store, rnd)
+        return ("giveup", local_rc or 1)
+    rc_port = master_port
+    try:
+        if args.node_rank == 0:
+            if negotiated_port:
+                rc_port = _free_port()
+            _reset_round_state(store, args.nproc_per_node * nnodes)
+            store.set(f"{prefix}/go/{rnd}", str(rc_port).encode())
+        else:
+            store.wait([f"{prefix}/go/{rnd}"],
+                       timeout=args.elastic_timeout)
+            rc_port = int(store.get(f"{prefix}/go/{rnd}"))
+    except Exception as e:
+        sys.stderr.write(f"[tpu_dist.launch] elastic restart handshake "
+                         f"failed ({e!r}); giving up\n")
+        return ("giveup", local_rc or 1)
+    return ("restart", rc_port)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -304,13 +442,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.max_restarts < 0:
         sys.stderr.write(f"--max_restarts must be >= 0\n")
         return 2
-    if args.max_restarts > 0 and args.nnodes > 1:
-        # multi-node elastic needs a cross-launcher rendezvous-round
-        # protocol (every node must agree to restart together); the
-        # single-node world is relaunched whole, which needs no agreement
-        sys.stderr.write("--max_restarts requires --nnodes=1 (single-node "
-                         "elastic); multi-node restart coordination is not "
-                         "implemented\n")
+    if args.max_restarts > 0 and args.nnodes > 1 and args.no_store:
+        # the cross-launcher restart agreement rides the store
+        sys.stderr.write("--max_restarts with --nnodes>1 needs the "
+                         "control-plane store; drop --no_store\n")
         return 2
     world_size = args.nproc_per_node * args.nnodes
 
@@ -319,15 +454,43 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
     negotiated_port = args.master_port == 0
 
+    multi_node_elastic = args.max_restarts > 0 and args.nnodes > 1
+    if multi_node_elastic and store is None:
+        # store setup failed above (warning already printed): without it
+        # there is no cross-node failure propagation or restart agreement
+        # — refuse rather than silently run non-elastic and then exit 1
+        # from a doomed agreement
+        sys.stderr.write("--max_restarts with --nnodes>1 needs a working "
+                         "control-plane store; fix the store setup error "
+                         "above or drop --max_restarts\n")
+        return 2
     restarts = 0
     try:
         while True:
             procs = _spawn_world(args, world_size, master_port, store_addr,
                                  restarts)
             exit_code, interrupted = _watch_world(args, procs, store,
-                                                  world_size)
-            if exit_code == 0 or interrupted \
-                    or restarts >= args.max_restarts:
+                                                  world_size, rnd=restarts)
+            if interrupted:
+                return exit_code
+            if multi_node_elastic:
+                # group decision: even a node whose workers all exited 0
+                # must wait — a peer's failure restarts everyone
+                verdict, val = _elastic_agree(args, store, restarts,
+                                              exit_code, negotiated_port,
+                                              master_port)
+                if verdict == "done":
+                    return 0
+                if verdict == "giveup":
+                    return val
+                master_port = val
+                restarts += 1
+                sys.stderr.write(
+                    f"[tpu_dist.launch] world failed; agreed restart "
+                    f"{restarts}/{args.max_restarts} across "
+                    f"{args.nnodes} nodes — relaunching\n")
+                continue
+            if exit_code == 0 or restarts >= args.max_restarts:
                 return exit_code
             restarts += 1
             sys.stderr.write(
@@ -335,24 +498,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                 f"restart {restarts}/{args.max_restarts} — relaunching "
                 f"the world\n")
             if store is not None:
-                # reset last round's control-plane state: liveness marks
-                # AND the teardown-barrier arrival counter — a partial
-                # teardown (one rank crashed mid-round) leaves the counter
-                # off-generation, which would make the next round's first
-                # teardown caller sail through the barrier early
-                for r in range(world_size):
-                    try:
-                        store.delete_key(f"tpu_dist/alive/{r}")
-                    except Exception:
-                        pass
-                try:
-                    store.delete_key("__barrier__/teardown")
-                except Exception:
-                    pass
+                _reset_round_state(store, world_size)
             if negotiated_port:
                 # the old coordinator socket may still be in TIME_WAIT;
-                # restarts are single-node only, so the children get the
-                # fresh port via env — no store re-publication needed
+                # single-node restarts hand children the fresh port via
+                # env — no store re-publication needed
                 master_port = _free_port()
     finally:
         if store is not None:
