@@ -133,18 +133,70 @@ def reduce_host(x, dst: int = 0, group=None, op: str = ReduceOp.SUM):
     return jax.tree.map(fn, gathered)
 
 
+# -- O(1)-per-rank store transport for rooted collectives ---------------------
+#
+# gather/scatter/all_to_all have a natural point-to-point structure; the
+# mesh collectives (process_allgather / broadcast_one_to_all) give every
+# rank the FULL list — O(world) traffic per rank.  When the control-plane
+# store is up (launcher default), these ride per-(src,dst) store keys
+# instead, so each rank moves only the entries it owns.  Same
+# matched-by-program-order discipline as send/recv; same trust model as
+# the object collectives (one job, pickled trees on the wire).
+
+_coll_seq: dict = {}    # (op, root) -> next sequence number
+
+
+def _coll_store():
+    import importlib
+    rdzv = importlib.import_module("tpu_dist.dist.rendezvous")
+    return rdzv._store
+
+
+def _coll_key(op: str, root: int, seq: int, peer: int) -> str:
+    return f"tpu_dist/coll/{op}/{root}/{seq}/{peer}"
+
+
+def _tree_to_bytes(tree) -> bytes:
+    return pickle.dumps(jax.tree.map(np.asarray, tree))
+
+
+def _tree_from_bytes(raw: bytes):
+    return pickle.loads(raw)
+
+
 def gather_host(x, dst: int = 0, group=None) -> Optional[List]:
     """torch ``dist.gather`` parity: process ``dst`` returns the list of all
-    processes' values (index = rank); everyone else gets ``None``."""
+    processes' values (index = rank); everyone else gets ``None``.
+
+    With the control-plane store up, each rank posts only its own entry
+    and ``dst`` collects them — non-destination ranks transfer O(1), not
+    the O(world) of the all-gather fallback."""
     group = _default_group(group)
     _check_peer(dst, group, "dst")
-    if group.num_processes <= 1:
+    n = group.num_processes
+    if n <= 1:
         return [jax.tree.map(np.asarray, x)]
+    store = _coll_store()
+    if store is not None:
+        seq = _coll_seq.get(("gather", dst), 0)
+        _coll_seq[("gather", dst)] = seq + 1
+        if group.rank != dst:
+            store.set(_coll_key("gather", dst, seq, group.rank),
+                      _tree_to_bytes(x))
+            return None
+        out = []
+        for r in range(n):
+            if r == dst:
+                out.append(jax.tree.map(np.asarray, x))
+            else:
+                key = _coll_key("gather", dst, seq, r)
+                out.append(_tree_from_bytes(store.get(key)))
+                store.delete_key(key)
+        return out
     from jax.experimental import multihost_utils
     gathered = multihost_utils.process_allgather(x)
     if group.rank != dst:
         return None
-    n = group.num_processes
     return [jax.tree.map(lambda v: v[r], gathered) for r in range(n)]
 
 
@@ -176,11 +228,27 @@ def scatter_host(output_template, scatter_list: Optional[List] = None,
                     f"semantics)")
         if n <= 1:
             return payload[0]
-    else:
+    # O(1)-per-rank path: src posts one store key per destination, each
+    # rank fetches only its own entry (send/recv's matched-by-program-order
+    # discipline; entries never fan out to bystanders).  Falls back to one
+    # broadcast of the full list + local pick when no store is up.
+    store = _coll_store()
+    if store is not None:
+        seq = _coll_seq.get(("scatter", src), 0)
+        _coll_seq[("scatter", src)] = seq + 1
+        if group.rank == src:
+            for dst in range(n):
+                if dst != src:
+                    store.set(_coll_key("scatter", src, seq, dst),
+                              _tree_to_bytes(payload[dst]))
+            return payload[src]
+        key = _coll_key("scatter", src, seq, group.rank)
+        raw = store.get(key)       # blocks until src posts it
+        store.delete_key(key)
+        return _tree_from_bytes(raw)
+    if group.rank != src:
         payload = [jax.tree.map(lambda v: np.zeros_like(np.asarray(v)),
                                 output_template) for _ in range(n)]
-    # one broadcast of the full list, then local pick: simple and correct;
-    # an O(1)-per-rank path would ride the store like send/recv
     from jax.experimental import multihost_utils
     full = multihost_utils.broadcast_one_to_all(
         payload, is_source=group.rank == src)
@@ -274,8 +342,23 @@ def scatter_object_list(scatter_object_input_list: Optional[List[Any]] = None,
                 f"num_processes={n} entries, got {got}")
         if n <= 1:
             return scatter_object_input_list[0]
-    # one broadcast of the full list, then local pick (same trade-off as
-    # scatter_host; an O(1)-per-rank path would ride the store)
+    store = _coll_store()
+    if store is not None:
+        # O(1)-per-rank: one store key per destination (see gather_host)
+        seq = _coll_seq.get(("scatter_obj", src), 0)
+        _coll_seq[("scatter_obj", src)] = seq + 1
+        if group.rank == src:
+            for dst in range(n):
+                if dst != src:
+                    store.set(_coll_key("scatter_obj", src, seq, dst),
+                              pickle.dumps(scatter_object_input_list[dst]))
+            return scatter_object_input_list[src]
+        key = _coll_key("scatter_obj", src, seq, group.rank)
+        obj = pickle.loads(store.get(key))
+        store.delete_key(key)
+        return obj
+    # one broadcast of the full list, then local pick (the no-store
+    # fallback: O(world) per rank)
     full = broadcast_object_list(
         scatter_object_input_list if group.rank == src else [None] * n,
         src=src, group=group)
@@ -286,10 +369,11 @@ def all_to_all_host(input_list: List[Any], group=None) -> List[Any]:
     """torch ``dist.all_to_all`` parity: process *p* sends
     ``input_list[q]`` to process *q*; returns the received list, entry *r*
     = what rank *r* addressed to this process.  Rides the object transport,
-    so entries may be arrays of any (per-pair) shape or arbitrary objects;
-    like :func:`scatter_host`, the full exchange is one all-gather — fine
-    for control-plane traffic, not for hot-path tensor redistribution
-    (that's the in-jit :func:`tpu_dist.collectives.all_to_all`)."""
+    so entries may be arrays of any (per-pair) shape or arbitrary objects.
+    With the control-plane store up, pairwise store keys move only each
+    rank's own row and column; without it, the fallback is one full
+    all-gather.  Control-plane traffic either way — hot-path tensor
+    redistribution is the in-jit :func:`tpu_dist.collectives.all_to_all`."""
     group = _default_group(group)
     n = group.num_processes
     if len(input_list) != n:
@@ -297,6 +381,29 @@ def all_to_all_host(input_list: List[Any], group=None) -> List[Any]:
                          f"(num_processes={n}), got {len(input_list)}")
     if n <= 1:
         return list(input_list)
+    store = _coll_store()
+    if store is not None:
+        # pairwise store keys: rank p moves only its row (sends) and its
+        # column (receives) — not every rank x rank entry like the
+        # all-gather fallback
+        me = group.rank
+        seq = _coll_seq.get(("a2a", 0), 0)
+        _coll_seq[("a2a", 0)] = seq + 1
+        for q in range(n):
+            if q != me:
+                # plain pickle (object transport): entries may be arrays
+                # OR arbitrary objects — no np coercion on the wire
+                store.set(_coll_key("a2a", q, seq, me),
+                          pickle.dumps(input_list[q]))
+        out = []
+        for r in range(n):
+            if r == me:
+                out.append(input_list[me])
+            else:
+                key = _coll_key("a2a", me, seq, r)
+                out.append(pickle.loads(store.get(key)))
+                store.delete_key(key)
+        return out
     rows = all_gather_object(list(input_list), group)
     return [rows[r][group.rank] for r in range(n)]
 
