@@ -74,8 +74,8 @@ def _better(new: dict, old: dict) -> dict:
 
 def main() -> None:
     sys.path.insert(0, _REPO)
-    from benchmarks import (attention, input_pipeline, moe_lm, resnet_cifar,
-                            scaling, transformer_lm)
+    from benchmarks import (attention, imagenet_e2e, input_pipeline, moe_lm,
+                            resnet_cifar, scaling, transformer_lm)
 
     out = os.path.join(_REPO, "BENCH_EXTENDED.json")
     previous = {}
@@ -94,6 +94,7 @@ def main() -> None:
         "transformer_lm": "transformer_lm_bf16_train_tokens_per_sec_per_chip",
         "moe_lm": "transformer_moe_lm_bf16_train_tokens_per_sec_per_chip",
         "lm_long": "transformer_lm_long_context_8k_bf16_tokens_per_sec_per_chip",
+        "imagenet_e2e": "resnet50_imagenet_e2e_sustained_images_per_sec",
     }
     results = []
     for name, fn in (("resnet_cifar", resnet_cifar.run),
@@ -102,7 +103,8 @@ def main() -> None:
                      ("attention", attention.run),
                      ("transformer_lm", transformer_lm.run),
                      ("moe_lm", moe_lm.run),
-                     ("lm_long", transformer_lm.run_long)):
+                     ("lm_long", transformer_lm.run_long),
+                     ("imagenet_e2e", imagenet_e2e.run)):
         try:
             r = fn()
         except Exception as e:  # record the failure, keep the rest running
