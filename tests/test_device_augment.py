@@ -117,3 +117,41 @@ class TestDeviceLoaderAugment:
         dev.set_epoch(1)
         ep1 = [np.asarray(x) for x, _ in dev]
         assert not np.array_equal(batches[0][0], ep1[0])
+
+
+class TestImagenetEval:
+    def test_identity_resize_equals_host_centercrop(self, rng):
+        """Input short side == resize: the device path must reduce to an
+        exact integer center crop + normalize (host-oracle equality)."""
+        x8 = rng.integers(0, 256, (3, 256, 256, 3)).astype(np.uint8)
+        aug = DeviceAugment.imagenet_eval(224, resize=256)
+        got = np.asarray(aug(jnp.asarray(x8), jax.random.key(0)))
+        norm = transforms.Normalize(transforms.IMAGENET_MEAN,
+                                    transforms.IMAGENET_STD)
+        want = norm(x8[:, 16:240, 16:240].astype(np.float32) / 255.0)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_close_to_two_pass_host_pipeline(self, rng):
+        """Non-trivial scale: the single-pass device resample tracks the
+        host's Resize(256)+CenterCrop(224) two-pass pipeline (they differ
+        only by resampling error)."""
+        x8 = rng.integers(0, 256, (2, 320, 320, 3)).astype(np.uint8)
+        aug = DeviceAugment.imagenet_eval(224, resize=256)
+        got = np.asarray(aug(jnp.asarray(x8), jax.random.key(0)))
+        host = transforms.Compose([
+            transforms.Resize(256),
+            transforms.CenterCrop(224),
+            transforms.Normalize(transforms.IMAGENET_MEAN,
+                                 transforms.IMAGENET_STD),
+        ])
+        want = host(x8.astype(np.float32) / 255.0)
+        # normalized units; resampling-order error stays small
+        assert np.abs(got - want).mean() < 0.05
+        assert np.abs(got - want).max() < 1.0
+
+    def test_deterministic_ignores_key(self, rng):
+        x8 = rng.integers(0, 256, (2, 64, 64, 3)).astype(np.uint8)
+        aug = DeviceAugment.imagenet_eval(32, resize=48)
+        a = np.asarray(aug(jnp.asarray(x8), jax.random.key(0)))
+        b = np.asarray(aug(jnp.asarray(x8), jax.random.key(99)))
+        np.testing.assert_array_equal(a, b)

@@ -102,10 +102,11 @@ class DeviceAugment:
     def __init__(self, size, mode: str = "resized_crop",
                  scale=(0.08, 1.0), ratio=(3.0 / 4.0, 4.0 / 3.0),
                  padding: int = 0, flip_p: float = 0.5,
+                 resize: Optional[int] = None,
                  mean: Sequence[float] = IMAGENET_MEAN,
                  std: Sequence[float] = IMAGENET_STD,
                  dtype=jnp.float32):
-        if mode not in ("resized_crop", "pad_crop", "none"):
+        if mode not in ("resized_crop", "pad_crop", "center_crop", "none"):
             raise ValueError(f"unknown mode {mode!r}")
         self.size = _pair(size)
         self.mode = mode
@@ -113,6 +114,7 @@ class DeviceAugment:
         self.ratio = tuple(ratio)
         self.padding = int(padding)
         self.flip_p = float(flip_p)
+        self.resize = resize
         self.mean = tuple(float(m) for m in mean)
         self.std = tuple(float(s) for s in std)
         self.dtype = dtype
@@ -122,6 +124,18 @@ class DeviceAugment:
     def imagenet(cls, size: int = 224, dtype=jnp.float32, **kw):
         return cls(size, mode="resized_crop", mean=IMAGENET_MEAN,
                    std=IMAGENET_STD, dtype=dtype, **kw)
+
+    @classmethod
+    def imagenet_eval(cls, size: int = 224, resize: int = 256,
+                      dtype=jnp.float32, **kw):
+        """torchvision eval pipeline ``Resize(resize)+CenterCrop(size)`` as
+        ONE device resample: the short side scaled to ``resize`` then the
+        central ``size``² window is a single centered crop box in the
+        ORIGINAL image of short-side fraction size/resize — no intermediate
+        resized image is ever materialized.  Deterministic (no random
+        draws); the ``key`` argument is accepted and ignored."""
+        return cls(size, mode="center_crop", resize=resize, flip_p=0.0,
+                   mean=IMAGENET_MEAN, std=IMAGENET_STD, dtype=dtype, **kw)
 
     @classmethod
     def cifar10(cls, size: int = 32, padding: int = 4, dtype=jnp.float32,
@@ -138,6 +152,7 @@ class DeviceAugment:
         mean = jnp.asarray(self.mean, jnp.float32)
         std = jnp.asarray(self.std, jnp.float32)
         mode, out_dtype = self.mode, self.dtype
+        resize = self.resize
 
         # note: branches on mode/pad/flip_p resolve at TRACE time (static)
         def fn(x, key):
@@ -165,6 +180,21 @@ class DeviceAugment:
                 top = jax.random.uniform(k_top, (n,)) * (h - ch)
                 left = jax.random.uniform(k_left, (n,)) * (w - cw)
                 x = bilinear_crop_resize(x, top, left, ch, cw, (oh, ow))
+            elif mode == "center_crop":
+                # Resize(short side -> `resize`) + CenterCrop(oh, ow),
+                # composed into one crop box in the original image: the
+                # crop covers (oh/resize, ow/resize) of the short side,
+                # centered (matches torchvision's eval pipeline up to its
+                # two-pass resampling error)
+                short = float(min(h, w))
+                ch_c = short * oh / resize
+                cw_c = short * ow / resize
+                top = jnp.full((n,), (h - ch_c) / 2.0, jnp.float32)
+                left = jnp.full((n,), (w - cw_c) / 2.0, jnp.float32)
+                x = bilinear_crop_resize(x, top, left,
+                                         jnp.full((n,), ch_c, jnp.float32),
+                                         jnp.full((n,), cw_c, jnp.float32),
+                                         (oh, ow))
             elif mode == "pad_crop":
                 if pad:
                     x = jnp.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
