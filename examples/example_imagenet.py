@@ -54,6 +54,10 @@ def main():
                         help="full f32 compute (default is mixed bf16)")
     parser.add_argument("--sync-bn", action="store_true")
     parser.add_argument("--max-steps", default=0, type=int)
+    parser.add_argument("--evaluate", action="store_true",
+                        help="held-out evaluation after training "
+                             "(Resize+CenterCrop eval pipeline; on-device "
+                             "by default, host under --host-augment)")
     parser.add_argument("--local_rank", default=None, type=int,
                         help="accepted for the classic launcher argv form")
     args = parser.parse_args()
@@ -147,6 +151,43 @@ def main():
             break
     if rank == 0:
         print("Training complete in:", datetime.now() - start)
+
+    if args.evaluate:
+        # held-out eval through the torchvision pipeline (Resize 256 +
+        # CenterCrop 224 + Normalize) — on device as one resample
+        # (DeviceAugment.imagenet_eval) in the default mode, on host
+        # workers under --host-augment
+        from tpu_dist.data import DeviceAugment
+        if args.imagefolder:
+            ev_ds = ImageFolder(args.imagefolder,
+                                sample_size=(args.image_size + 32,
+                                             args.image_size + 32))
+        else:
+            ev_ds = SyntheticImageNet(train=False,
+                                      n=max(args.synthetic_size // 4, 64),
+                                      image_size=args.image_size,
+                                      num_classes=args.num_classes)
+        ev_aug = None
+        if args.host_augment:
+            ev_ds.transform = transforms.Compose([
+                transforms.Resize(args.image_size + 32),
+                transforms.CenterCrop(args.image_size),
+                transforms.Normalize(transforms.IMAGENET_MEAN,
+                                     transforms.IMAGENET_STD)])
+        else:
+            # f32 out: ddp.evaluate runs the f32 master params (no
+            # compute-dtype cast on the eval path)
+            ev_aug = DeviceAugment.imagenet_eval(
+                args.image_size, resize=args.image_size + 32)
+        ev_loader = DeviceLoader(
+            DataLoader(ev_ds, batch_size=world_batch, drop_last=False,
+                       num_workers=args.num_workers,
+                       to_float=args.host_augment),
+            group=pg, local_shards=False, augment=ev_aug)
+        res = ddp.evaluate(state, ev_loader)
+        if rank == 0:
+            print("Eval: loss {:.3f}, acc {:.3f} ({} samples)".format(
+                res["loss"], res["accuracy"], res["count"]))
     dist.destroy_process_group()
 
 
