@@ -322,3 +322,110 @@ class TestMultiNodeElastic:
         # restart — not an agreement timeout dressed up as failure
         assert "agreed restart 1/1" in out0 + out1, (out0, out1)
         assert "elastic agreement failed" not in out0 + out1, (out0, out1)
+
+
+class TestMultiNodeElasticWithCheckpoint:
+    """The full fault-tolerance story across nodes: a worker crashes
+    mid-training, BOTH launchers agree and relaunch (store-negotiated
+    coordinator port re-published for round 1), and the workers resume
+    from the latest checkpoint and finish — crash-at-step-k / resume /
+    complete, multi-node."""
+
+    _WORKER = textwrap.dedent("""
+        import json, os, sys
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+        import jax.numpy as jnp
+        import tpu_dist.dist as dist
+        from tpu_dist import checkpoint, nn, optim
+        from tpu_dist.parallel import DistributedDataParallel
+
+        out_dir = sys.argv[1]
+        rnd = os.environ["TPU_DIST_RESTART_COUNT"]
+
+        pg = dist.init_process_group(backend="cpu", init_method="env://")
+        rank = dist.get_rank()
+
+        class Net(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 3)
+            def forward(self, x):
+                return self.fc(x)
+
+        ddp = DistributedDataParallel(
+            Net(), optimizer=optim.SGD(lr=0.1),
+            loss_fn=nn.CrossEntropyLoss(), group=pg, donate=False)
+        state = ddp.init(seed=0)
+
+        ckdir = os.path.join(out_dir, "ck")
+        resumed_from = 0
+        last = checkpoint.latest_step(ckdir)
+        if last is not None:
+            state = checkpoint.restore(ckdir, state, step=last)
+            resumed_from = int(state.step)
+
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(8, 4)).astype(np.float32))
+        y = jnp.asarray(rng.integers(0, 3, 8).astype(np.int32))
+        for step in range(int(state.step), 6):
+            state, m = ddp.train_step(state, x, y)
+            if rank == 0:
+                checkpoint.save(ckdir, state, step=int(state.step), keep=3)
+            dist.barrier()
+            if rnd == "0" and rank == 1 and int(state.step) == 3:
+                sys.exit(17)   # crash AFTER step 3 is checkpointed
+
+        rec = {"rank": rank, "round": rnd, "resumed_from": resumed_from,
+               "final_step": int(state.step),
+               "loss": float(m["loss"])}
+        with open(os.path.join(out_dir, f"done{rank}_r{rnd}.json"),
+                  "w") as f:
+            json.dump(rec, f)
+        dist.destroy_process_group()
+    """)
+
+    def test_crash_resume_complete(self, tmp_path):
+        import json
+        import socket
+        import subprocess as sp
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            store_port = s.getsockname()[1]
+        script = tmp_path / "trainer.py"
+        script.write_text(self._WORKER)
+        env = dict(os.environ)
+        env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+        def launcher(node_rank):
+            # --master_port=0: coordinator port store-negotiated, and
+            # re-negotiated + re-published for the restart round
+            return sp.Popen(
+                [sys.executable, "-m", "tpu_dist.launch",
+                 "--nproc_per_node=1", "--nnodes=2",
+                 f"--node_rank={node_rank}",
+                 "--master_addr=127.0.0.1", "--master_port=0",
+                 f"--store_port={store_port}",
+                 "--max_restarts=2", "--elastic_timeout=120",
+                 str(script), str(tmp_path)],
+                env=env, stderr=sp.PIPE, text=True)
+
+        l0 = launcher(0)
+        time.sleep(0.5)
+        l1 = launcher(1)
+        out0 = l0.communicate(timeout=600)[1]
+        out1 = l1.communicate(timeout=600)[1]
+        assert l0.returncode == 0, out0
+        assert l1.returncode == 0, out1
+        for rank in (0, 1):
+            with open(tmp_path / f"done{rank}_r1.json") as f:
+                rec = json.load(f)
+            assert rec["final_step"] == 6
+            # round 1 resumed from the last checkpoint BEFORE the crash
+            assert rec["resumed_from"] >= 3, rec
+            assert rec["round"] == "1"
+        assert "agreed restart 1/2" in out0 + out1
