@@ -68,7 +68,18 @@ def _better(new: dict, old: dict) -> dict:
                        e.get("resnet50_bf16_step_images_per_sec", 0)),
     }.get(new.get("metric"))
     if key is not None:
-        return new if key(new) >= key(old) else old
+        best = new if key(new) >= key(old) else old
+        if new.get("metric") == "imagenet_input_pipeline_vs_resnet50_step":
+            # the winning row may come from a contended window: carry the
+            # best ResNet-50 step rate ever measured so the chip-rate
+            # evidence survives the fed-first ranking
+            best = dict(best)
+            best["best_step_images_per_sec_ever"] = max(
+                e.get(k, 0) or 0
+                for e in (new, old)
+                for k in ("resnet50_bf16_step_images_per_sec",
+                          "best_step_images_per_sec_ever"))
+        return best
     return new
 
 
