@@ -211,10 +211,18 @@ class TestStandaloneAndRunAlias:
             "dist.destroy_process_group()\n")
         env = dict(os.environ)
         env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
-        r = subprocess.run(
-            [sys.executable, "-m", "tpu_dist.run", "--standalone",
-             "--nproc_per_node=2", str(script)],
-            cwd=_REPO, env=env, capture_output=True, text=True, timeout=300)
+        # one retry: --standalone picks a free port, and under a loaded
+        # full-suite run the pick can race another process (TOCTOU) or the
+        # rendezvous can time out on the starved single core — both are
+        # environment artifacts, not launcher behavior
+        for attempt in (0, 1):
+            r = subprocess.run(
+                [sys.executable, "-m", "tpu_dist.run", "--standalone",
+                 "--nproc_per_node=2", str(script)],
+                cwd=_REPO, env=env, capture_output=True, text=True,
+                timeout=300)
+            if r.returncode == 0:
+                break
         assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
         assert "standalone rank 0 backend cpu" in r.stdout
         assert "standalone rank 1 backend cpu" in r.stdout
