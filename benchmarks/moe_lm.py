@@ -12,9 +12,12 @@ is proven separately on the 8-device dryrun (__graft_entry__._dryrun_dp_ep,
 what a chip actually sustains running the MoE compute graph (router +
 dispatch + 2-of-8 expert FFNs + combine) through the standard DDP bf16
 fused step, timed with the same scan-differenced methodology as the dense
-row.  Per-chip batch 2 (not the dense row's 8): the GShard dispatch/combine
-temps scale with tokens x experts and OOM 16G HBM at batch 8 (measured
-29.8G) — tokens/sec is reported per chip either way.
+row.  ``dispatch="gather"`` (nn/moe.py index-map dispatch) is the default
+here: the einsum path's GShard ``(N, E, C)`` dispatch/combine temps scale
+with tokens x experts and OOM 16G HBM at the dense row's per-chip batch 8
+(measured 29.8G; the oversized graph crashes the sandbox's remote compile
+helper outright), capping that path at batch 2 — gather dispatch carries
+batch 8 and its better MXU utilization.
 """
 
 from __future__ import annotations
@@ -22,9 +25,10 @@ from __future__ import annotations
 import json
 
 
-def run(batch: int = 2, seq_len: int = 2048, dim: int = 768,
+def run(batch: int = 8, seq_len: int = 2048, dim: int = 768,
         depth: int = 12, heads: int = 12, vocab: int = 32768,
-        experts: int = 8, steps: int = 20, reps: int = 3) -> dict:
+        experts: int = 8, steps: int = 20, reps: int = 3,
+        dispatch: str = "gather") -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -43,7 +47,7 @@ def run(batch: int = 2, seq_len: int = 2048, dim: int = 768,
 
     model = TransformerLM(vocab_size=vocab, dim=dim, depth=depth,
                           num_heads=heads, max_seq_len=seq_len,
-                          num_experts=experts)
+                          num_experts=experts, moe_dispatch=dispatch)
     ddp = DistributedDataParallel(
         model, optimizer=optim.SGD(lr=0.01),
         loss_fn=nn.CrossEntropyLoss(fused=True), group=pg, donate=True,
@@ -77,6 +81,7 @@ def run(batch: int = 2, seq_len: int = 2048, dim: int = 768,
         "step_ms": round(sec * 1e3, 2),
         "model": {"params_M": round(n_params / 1e6, 1),
                   "active_params_M": round(n_active / 1e6, 1),
+                  "dispatch": dispatch,
                   "experts": experts, "top_k": 2, "depth": depth,
                   "dim": dim, "heads": heads, "seq_len": seq_len,
                   "per_chip_batch": batch, "vocab": vocab},

@@ -54,14 +54,38 @@ def _naive_moe(layer, p, x):
     return out
 
 
+@pytest.mark.parametrize("dispatch", ["einsum", "gather"])
 @pytest.mark.parametrize("top_k,normalize", [(1, False), (2, True),
                                              (2, False)])
-def test_moe_matches_per_token_reference(rng, top_k, normalize):
-    layer, params = _layer(top_k=top_k, normalize_gates=normalize)
+def test_moe_matches_per_token_reference(rng, top_k, normalize, dispatch):
+    layer, params = _layer(top_k=top_k, normalize_gates=normalize,
+                           dispatch=dispatch)
     x = jnp.asarray(rng.standard_normal((12, DIM)).astype(np.float32))
     y = layer.apply(params, x)
     ref = _naive_moe(layer, params, np.asarray(x))
     np.testing.assert_allclose(np.asarray(y), ref, atol=1e-5)
+
+
+@pytest.mark.parametrize("top_k", [1, 2])
+def test_moe_gather_dispatch_matches_einsum(rng, top_k):
+    """The two dispatch realizations are the same function — forward and
+    gradients (params AND input), at a token count past 256 so the int32
+    slot bookkeeping (not representable in a bf16 cumsum) is exercised."""
+    n = 700
+    le, params = _layer(top_k=top_k, capacity_factor=1.1, dispatch="einsum")
+    lg, _ = _layer(top_k=top_k, capacity_factor=1.1, dispatch="gather")
+    x = jnp.asarray(rng.standard_normal((n, DIM)).astype(np.float32))
+
+    def loss(layer):
+        return lambda p, xx: (layer.apply(p, xx, state={})[0] ** 2).sum()
+
+    ye = le.apply(params, x, state={})[0]
+    yg = lg.apply(params, x, state={})[0]
+    np.testing.assert_allclose(np.asarray(ye), np.asarray(yg), atol=1e-5)
+    ge = jax.grad(loss(le), argnums=(0, 1))(params, x)
+    gg = jax.grad(loss(lg), argnums=(0, 1))(params, x)
+    for a, b in zip(jax.tree.leaves(ge), jax.tree.leaves(gg)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
 
 
 def test_moe_batch_shape_and_state(rng):
@@ -76,9 +100,11 @@ def test_moe_batch_shape_and_state(rng):
     assert np.isfinite(aux) and 0.0 < aux <= E
 
 
-def test_moe_capacity_drops_tokens(rng):
+@pytest.mark.parametrize("dispatch", ["einsum", "gather"])
+def test_moe_capacity_drops_tokens(rng, dispatch):
     """capacity_factor small enough that some tokens get zero output."""
-    layer, params = _layer(top_k=1, capacity_factor=1e-9)  # capacity = 1
+    layer, params = _layer(top_k=1, capacity_factor=1e-9,
+                           dispatch=dispatch)  # capacity = 1
     x = jnp.asarray(rng.standard_normal((32, DIM)).astype(np.float32))
     y = np.asarray(layer.apply(params, x))
     zero_rows = (np.abs(y).max(-1) == 0.0).sum()

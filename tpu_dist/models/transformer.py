@@ -71,12 +71,15 @@ class TransformerLM(nn.Module):
                  mode: str = "ring", remat: bool = False,
                  num_experts: int = 0, moe_top_k: int = 2,
                  moe_every: int = 1, moe_capacity_factor: float = 1.25,
+                 moe_dispatch: str = "einsum",
                  norm: str = "layernorm", rope: bool = False,
                  rope_theta: float = 10000.0):
         """``num_experts > 0`` makes every ``moe_every``-th block's MLP a
         routed :class:`~tpu_dist.nn.MoELayer` (expert-parallel under
         :data:`~tpu_dist.parallel.MOE_EP_RULES`); aux load-balance losses
-        surface in the model state, see nn/moe.py.
+        surface in the model state, see nn/moe.py.  ``moe_dispatch=
+        "gather"`` selects the index-map dispatch (cheaper off the GSPMD
+        'expert' axis — see nn/moe.py).
 
         ``norm="rmsnorm"`` + ``rope=True`` gives the LLaMA-family recipe:
         RMS normalization and rotary position embeddings instead of the
@@ -97,7 +100,8 @@ class TransformerLM(nn.Module):
                 sequence_axis=sequence_axis, mode=mode, norm=norm,
                 rope=rope, rope_theta=rope_theta,
                 mlp=nn.MoELayer(dim, num_experts, top_k=moe_top_k,
-                                capacity_factor=moe_capacity_factor)
+                                capacity_factor=moe_capacity_factor,
+                                dispatch=moe_dispatch)
                 if moe else None))
         self.depth = depth
         self.causal = causal

@@ -11,15 +11,26 @@ TPU-first design — routing as dense einsums, not gather/scatter:
   h)``, ``w2 (E, h, d)``.  Under expert parallelism that axis is sharded
   ``P('expert')`` (see :data:`MOE_EP_RULES`) and every expert matmul is a
   batched einsum the MXU tiles directly.
-- Token routing is the GShard/Switch capacity formulation: top-k gating
-  probabilities become dense **dispatch/combine tensors** ``(N, E, C)``
-  built from one-hots and a cumsum position assignment — static shapes, no
-  data-dependent gather, so the whole layer jits and the XLA SPMD
-  partitioner inserts the token all-to-alls purely from the shardings
-  (einsum ``nec,nd->ecd`` with the output sharded over 'expert' IS the
-  dispatch all-to-all).  Tokens beyond an expert's capacity ``C =
-  ceil(k*N/E * capacity_factor)`` are dropped — their combine weights are
-  zero, so they pass through the surrounding residual unchanged.
+- Token routing is the GShard/Switch capacity formulation: a cumsum
+  position assignment gives every (choice, token) a slot at its chosen
+  expert; tokens beyond an expert's capacity ``C = ceil(k*N/E *
+  capacity_factor)`` are dropped — their combine weights are zero, so they
+  pass through the surrounding residual unchanged.  Two interchangeable
+  dispatch realizations (``dispatch=``), numerically identical outputs:
+
+  * ``"einsum"`` — dense **dispatch/combine tensors** ``(N, E, C)`` built
+    from one-hots: static shapes, no data-dependent indexing, and the XLA
+    SPMD partitioner inserts the token all-to-alls purely from the
+    shardings (einsum ``nec,nd->ecd`` with the output sharded over
+    'expert' IS the dispatch all-to-all).  The GSPMD/expert-parallel
+    default — but the ``(N, E, C)`` temps cost ``O(N*E*C*d)`` FLOPs and
+    HBM, which at LM scale rivals the expert FFNs themselves.
+  * ``"gather"`` — the routing is a partial permutation, so dispatch and
+    combine are **row-gathers** by the slot maps; custom VJPs express both
+    backward passes as gathers by the opposite map, so XLA never emits a
+    data scatter.  ``O(k*N*d)`` — use for single-device and shard_map/DDP
+    execution (layer internals are per-shard local there), where it is
+    strictly cheaper; prefer ``"einsum"`` under a GSPMD 'expert' axis.
 - The Switch **load-balancing auxiliary loss** ``E * sum_e f_e * p_e``
   (fraction of tokens routed to e times mean router probability of e) is
   published through the module-state mechanism (``state["aux_loss"]``):
@@ -42,6 +53,82 @@ from . import init as init_lib
 __all__ = ["MoELayer"]
 
 
+# -- gather dispatch: permutation as index maps, not one-hot einsums --------
+#
+# The GShard (N, E, C) dispatch/combine tensors cost O(N*E*C*d) FLOPs and
+# HBM — at GPT-2-small MoE shapes that is comparable to the expert FFNs
+# themselves and OOMs a 16G chip at per-chip batch 8.  But the routing is a
+# (partial) permutation: each (choice, token) lands in at most one (expert,
+# slot) cell.  So dispatch = one row-gather by the inverse map and combine =
+# one row-gather by the forward map; both backward passes are *also* pure
+# gathers (by the opposite map), which the custom VJPs below express so XLA
+# never emits a data scatter.  The only scatter anywhere is the int32
+# slot->choice inverse-map build (~0.1 ms at 32k tokens on v5e).  Integer
+# index arguments take no gradient (None cotangents).
+
+@jax.custom_vjp
+def _dispatch_rows(xt, token_for_slot, slot):
+    """xt (N, d) -> xs_flat (E*C, d): row token_for_slot[s], zeros if == N."""
+    pad = jnp.concatenate([xt, jnp.zeros((1, xt.shape[1]), xt.dtype)])
+    return pad[token_for_slot]
+
+
+def _dispatch_rows_fwd(xt, token_for_slot, slot):
+    return _dispatch_rows(xt, token_for_slot, slot), slot
+
+
+def _dispatch_rows_bwd(slot, g):
+    # grad_xt[i] = sum_j grad_xs[slot[j, i]]; dropped choices point at the
+    # appended zero row (slot == E*C)
+    g_pad = jnp.concatenate([g, jnp.zeros((1, g.shape[1]), g.dtype)])
+    gx = g_pad[slot.reshape(-1)].reshape(*slot.shape, g.shape[1])
+    return gx.sum(0), None, None
+
+
+_dispatch_rows.defvjp(_dispatch_rows_fwd, _dispatch_rows_bwd)
+
+
+@jax.custom_vjp
+def _combine_rows(out_flat, w, choice_for_slot, slot):
+    """y (N, d) = sum_j w[j, i] * out_flat[slot[j, i]] (pad row = zeros).
+
+    ``choice_for_slot`` (E*C,) is the inverse of ``slot``: the flattened
+    (choice-major) index occupying each slot, k*N if empty — only the
+    backward pass needs it, to invert the gy and w lookups as gathers.
+    """
+    pad = jnp.concatenate([out_flat,
+                           jnp.zeros((1, out_flat.shape[1]), out_flat.dtype)])
+    g = pad[slot.reshape(-1)].reshape(*slot.shape, out_flat.shape[1])
+    return (g * w[:, :, None].astype(g.dtype)).sum(0)
+
+
+def _combine_rows_fwd(out_flat, w, choice_for_slot, slot):
+    return (_combine_rows(out_flat, w, choice_for_slot, slot),
+            (out_flat, w, choice_for_slot, slot))
+
+
+def _combine_rows_bwd(res, gy):
+    out_flat, w, choice_for_slot, slot = res
+    k, n = slot.shape
+    d = out_flat.shape[1]
+    # grad_out[s] = w[choice(s)] * gy[token(s)]; empty slots hit the padded
+    # zero rows of both lookups (choice_for_slot == k*n -> token == n)
+    token_for_slot = jnp.where(choice_for_slot == k * n, n,
+                               choice_for_slot % jnp.int32(n))
+    gy_pad = jnp.concatenate([gy, jnp.zeros((1, d), gy.dtype)])
+    w_flat = jnp.concatenate([w.reshape(-1), jnp.zeros((1,), w.dtype)])
+    w_at_slot = w_flat[choice_for_slot]
+    g_out = w_at_slot[:, None].astype(gy.dtype) * gy_pad[token_for_slot]
+    # grad_w[j, i] = dot(gy[i], out_pad[slot[j, i]])
+    out_pad = jnp.concatenate([out_flat, jnp.zeros((1, d), out_flat.dtype)])
+    g_rows = out_pad[slot.reshape(-1)].reshape(k, n, d)
+    g_w = (g_rows * gy[None, :, :].astype(g_rows.dtype)).sum(-1)
+    return g_out, g_w.astype(w.dtype), None, None
+
+
+_combine_rows.defvjp(_combine_rows_fwd, _combine_rows_bwd)
+
+
 class MoELayer(Module):
     """Top-k routed mixture of expert FFNs (drop-in for a transformer MLP).
 
@@ -54,22 +141,29 @@ class MoELayer(Module):
             per-expert token budget; tokens past capacity are dropped.
         normalize_gates: renormalize the k selected gate values to sum to 1
             (GShard semantics); off uses raw softmax probabilities (Switch).
+        dispatch: ``"einsum"`` (GSPMD/ep-friendly dense dispatch tensors)
+            or ``"gather"`` (index-map permutation — cheaper for
+            single-device / shard_map execution); see module docstring.
     """
 
     def __init__(self, dim: int, num_experts: int, hidden: int = 0,
                  top_k: int = 2, capacity_factor: float = 1.25,
-                 normalize_gates: bool = True):
+                 normalize_gates: bool = True, dispatch: str = "einsum"):
         super().__init__()
         if num_experts < 2:
             raise ValueError(f"num_experts must be >= 2, got {num_experts}")
         if not 1 <= top_k <= num_experts:
             raise ValueError(f"top_k {top_k} not in [1, {num_experts}]")
+        if dispatch not in ("einsum", "gather"):
+            raise ValueError(f"dispatch must be 'einsum' or 'gather', "
+                             f"got {dispatch!r}")
         self.dim = dim
         self.num_experts = num_experts
         self.hidden = hidden or 4 * dim
         self.top_k = top_k
         self.capacity_factor = capacity_factor
         self.normalize_gates = normalize_gates
+        self.dispatch = dispatch
 
     def create_params(self, key):
         kr, k1, k2 = jax.random.split(key, 3)
@@ -118,27 +212,47 @@ class MoELayer(Module):
         # slot assignment: flatten the k choices in priority order (all
         # first choices, then all second choices, ...) and cumsum the
         # one-hots — each (choice, token) gets its arrival index at the
-        # chosen expert; indices >= capacity are dropped
-        oh = jax.nn.one_hot(gate_idx.T, e, dtype=xt.dtype)       # (k, N, E)
-        flat = oh.reshape(k * n, e)
+        # chosen expert; indices >= capacity are dropped.  Bookkeeping runs
+        # in int32 no matter what xt's dtype is: a bf16 cumsum rounds
+        # positions past 256 and mis-slots tokens.
+        oh_i = jax.nn.one_hot(gate_idx.T, e, dtype=jnp.int32)    # (k, N, E)
+        flat = oh_i.reshape(k * n, e)
         pos = (jnp.cumsum(flat, axis=0) - flat)                  # (k*N, E)
         pos = (pos * flat).sum(-1).reshape(k, n)                 # (k, N)
         keep = (pos < c).astype(xt.dtype)                        # (k, N)
 
-        slot_oh = jax.nn.one_hot(pos.astype(jnp.int32), c,
-                                 dtype=xt.dtype)                 # (k, N, C)
-        # (k, N, E, C) collapsed over k → dispatch/combine (N, E, C)
-        dispatch = jnp.einsum("kne,knc,kn->nec", oh, slot_oh, keep)
-        combine = jnp.einsum("kne,knc,kn->nec", oh, slot_oh,
-                             keep * gate_vals.T)
-
-        xs = jnp.einsum("nec,nd->ecd", dispatch, xt)             # per-expert
+        if self.dispatch == "gather":
+            # forward map: (choice, token) -> flat slot e*C + pos (trash
+            # slot E*C for dropped); inverse map via one int32 scatter
+            slot = jnp.where(keep > 0,
+                             gate_idx.T.astype(jnp.int32) * c + pos,
+                             e * c)                              # (k, N)
+            choice_for_slot = (
+                jnp.full((e * c + 1,), k * n, jnp.int32)
+                .at[slot.reshape(-1)]
+                .set(jnp.arange(k * n, dtype=jnp.int32), mode="drop")[:-1])
+            token_for_slot = jnp.where(choice_for_slot == k * n, n,
+                                       choice_for_slot % jnp.int32(n))
+            xs = _dispatch_rows(xt, token_for_slot, slot).reshape(e, c, d)
+            combine_t = None
+        else:
+            slot_oh = jax.nn.one_hot(pos, c, dtype=xt.dtype)     # (k, N, C)
+            oh = oh_i.astype(xt.dtype)
+            # (k, N, E, C) collapsed over k → dispatch/combine (N, E, C)
+            dispatch_t = jnp.einsum("kne,knc,kn->nec", oh, slot_oh, keep)
+            combine_t = jnp.einsum("kne,knc,kn->nec", oh, slot_oh,
+                                   keep * gate_vals.T)
+            xs = jnp.einsum("nec,nd->ecd", dispatch_t, xt)
         hdn = jax.nn.gelu(jnp.einsum("ecd,edh->ech", xs, p["w1"])
                           + p["b1"][:, None, :])
         out = jnp.einsum("ech,ehd->ecd", hdn, p["w2"]) + p["b2"][:, None, :]
         # dropped tokens have all-zero combine rows → output 0; the
         # surrounding residual connection passes them through unchanged
-        y = jnp.einsum("nec,ecd->nd", combine, out)
+        if self.dispatch == "gather":
+            y = _combine_rows(out.reshape(e * c, d), keep * gate_vals.T,
+                              choice_for_slot, slot)
+        else:
+            y = jnp.einsum("nec,ecd->nd", combine_t, out)
 
         # Switch load-balance loss on first-choice assignments
         frac = jnp.mean(jax.nn.one_hot(gate_idx[:, 0], e, dtype=xt.dtype),
