@@ -105,6 +105,7 @@ def main() -> None:
         "transformer_lm": "transformer_lm_bf16_train_tokens_per_sec_per_chip",
         "moe_lm": "transformer_moe_lm_bf16_train_tokens_per_sec_per_chip",
         "lm_long": "transformer_lm_long_context_8k_bf16_tokens_per_sec_per_chip",
+        "lm_32k": "transformer_lm_long_context_32k_bf16_tokens_per_sec_per_chip",
         "imagenet_e2e": "resnet50_imagenet_e2e_sustained_images_per_sec",
     }
     results = []
@@ -115,6 +116,7 @@ def main() -> None:
                      ("transformer_lm", transformer_lm.run),
                      ("moe_lm", moe_lm.run),
                      ("lm_long", transformer_lm.run_long),
+                     ("lm_32k", transformer_lm.run_32k),
                      ("imagenet_e2e", imagenet_e2e.run)):
         try:
             r = fn()
