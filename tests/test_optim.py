@@ -102,3 +102,71 @@ def test_adamw_rejects_bad_hparams():
         AdamW(betas=(1.0, 0.999))
     with pytest.raises(ValueError):
         AdamW(eps=0.0)
+
+
+@pytest.mark.parametrize("cls,tcls,cfg", [
+    ("RMSprop", torch.optim.RMSprop, dict(lr=1e-2)),
+    ("RMSprop", torch.optim.RMSprop, dict(lr=1e-2, momentum=0.9,
+                                          weight_decay=1e-4)),
+    ("RMSprop", torch.optim.RMSprop, dict(lr=1e-3, alpha=0.95,
+                                          centered=True, momentum=0.5)),
+    ("Adagrad", torch.optim.Adagrad, dict(lr=1e-2)),
+    ("Adagrad", torch.optim.Adagrad, dict(lr=1e-2, lr_decay=0.1,
+                                          weight_decay=1e-4)),
+    ("Adagrad", torch.optim.Adagrad,
+     dict(lr=1e-2, initial_accumulator_value=0.3)),
+])
+def test_rmsprop_adagrad_match_torch(rng, cls, tcls, cfg):
+    from tpu_dist import optim
+
+    w0 = rng.standard_normal((5, 4)).astype(np.float32)
+    tparam = torch.nn.Parameter(torch.tensor(w0.copy()))
+    topt = tcls([tparam], **cfg)
+
+    opt = getattr(optim, cls)(**cfg)
+    params = {"w": jnp.asarray(w0)}
+    opt_state = opt.init(params)
+
+    for step in range(6):
+        g = rng.standard_normal((5, 4)).astype(np.float32)
+        tparam.grad = torch.tensor(g.copy())
+        topt.step()
+        params, opt_state = opt.update({"w": jnp.asarray(g)}, opt_state,
+                                       params)
+        np.testing.assert_allclose(np.asarray(params["w"]),
+                                   tparam.detach().numpy(), atol=2e-6,
+                                   err_msg=f"step {step} {cls} {cfg}")
+
+
+def test_rmsprop_adagrad_reject_bad_hparams():
+    from tpu_dist.optim import Adagrad, RMSprop
+
+    with pytest.raises(ValueError):
+        RMSprop(alpha=1.0)
+    with pytest.raises(ValueError):
+        RMSprop(momentum=-0.1)
+    with pytest.raises(ValueError):
+        Adagrad(lr_decay=-1.0)
+    with pytest.raises(ValueError):
+        Adagrad(initial_accumulator_value=-0.5)
+
+
+def test_memory_introspection_smoke():
+    """torch.cuda.memory_* analogues: callable everywhere; on platforms
+    with no allocator stats (CPU tests) they degrade to 0/(0,0) instead
+    of raising."""
+    from tpu_dist import utils
+
+    live = jnp.ones((256, 256))  # ensure at least one live device buffer
+    live.block_until_ready()
+    stats = utils.memory_stats()
+    assert isinstance(stats, dict)
+    allocated = utils.memory_allocated()
+    peak = utils.max_memory_allocated()
+    free, total = utils.mem_get_info()
+    assert 0 <= allocated and 0 <= peak
+    assert 0 <= free and (total == 0 or free <= total)
+    assert isinstance(utils.memory_summary(), str)
+    if stats:  # a real accelerator: the live buffer must show up
+        assert allocated > 0 or peak > 0
+    del live

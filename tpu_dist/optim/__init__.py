@@ -1,14 +1,17 @@
 """tpu_dist.optim — pure-pytree optimizers + compiled-in lr schedules."""
 
+from .adagrad import Adagrad
 from .adamw import Adam, AdamW
 from .clip import clip_grad_norm, global_norm
 from .ema import EMA
 from .lr_scheduler import (constant_lr, cosine_annealing_lr, exponential_lr,
                            linear_lr, multistep_lr, sequential_lr, step_lr,
                            warmup_cosine)
+from .rmsprop import RMSprop
 from .sgd import SGD
 
-__all__ = ["SGD", "Adam", "AdamW", "EMA", "clip_grad_norm", "global_norm",
+__all__ = ["SGD", "Adam", "AdamW", "RMSprop", "Adagrad", "EMA",
+           "clip_grad_norm", "global_norm",
            "step_lr", "multistep_lr", "exponential_lr", "linear_lr",
            "cosine_annealing_lr", "constant_lr", "sequential_lr",
            "warmup_cosine"]

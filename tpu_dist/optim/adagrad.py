@@ -1,0 +1,68 @@
+"""Adagrad — torch.optim.Adagrad parity, pure-pytree.
+
+Same pure-pytree contract as :class:`tpu_dist.optim.SGD` (see rmsprop.py
+for the rationale).  Update rule (torch semantics, including the built-in
+lr decay over update count t = 1, 2, ...):
+
+    g    = g + wd * p
+    clr  = lr / (1 + (t - 1) * lr_decay)
+    sum += g^2
+    p   -= clr * g / (sqrt(sum) + eps)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Union
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Adagrad"]
+
+LrLike = Union[float, Callable[[jnp.ndarray], jnp.ndarray]]
+
+
+class Adagrad:
+    def __init__(self, lr: LrLike = 1e-2, lr_decay: float = 0.0,
+                 weight_decay: float = 0.0,
+                 initial_accumulator_value: float = 0.0,
+                 eps: float = 1e-10):
+        if lr_decay < 0.0:
+            raise ValueError(f"Invalid lr_decay {lr_decay}")
+        if eps <= 0.0:
+            raise ValueError(f"Invalid eps {eps}")
+        if initial_accumulator_value < 0.0:
+            raise ValueError(
+                f"Invalid initial_accumulator_value "
+                f"{initial_accumulator_value}")
+        self.lr = lr
+        self.lr_decay = lr_decay
+        self.weight_decay = weight_decay
+        self.initial_accumulator_value = initial_accumulator_value
+        self.eps = eps
+
+    def init(self, params) -> Dict[str, Any]:
+        iv = self.initial_accumulator_value
+        return {"sum": jax.tree.map(
+                    lambda p: jnp.full_like(p, iv), params),
+                "step": jnp.zeros((), jnp.int32)}
+
+    def update(self, grads, opt_state, params):
+        """Return ``(new_params, new_opt_state)``; pure function."""
+        wd = self.weight_decay
+        t = opt_state["step"]  # prior update count; torch's t-1 with t>=1
+        lr = self.lr(t) if callable(self.lr) else self.lr
+        clr = lr / (1.0 + t.astype(jnp.float32) * self.lr_decay)
+
+        if wd:
+            grads = jax.tree.map(lambda g, p: g + wd * p, grads, params)
+        new_sum = jax.tree.map(lambda s, g: s + jnp.square(g),
+                               opt_state["sum"], grads)
+        new_params = jax.tree.map(
+            lambda p, g, s: p - clr * g / (jnp.sqrt(s) + self.eps),
+            params, grads, new_sum)
+        return new_params, {"sum": new_sum, "step": t + 1}
+
+    def __repr__(self):
+        return (f"Adagrad(lr={self.lr}, lr_decay={self.lr_decay}, "
+                f"weight_decay={self.weight_decay})")

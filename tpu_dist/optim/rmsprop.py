@@ -1,0 +1,102 @@
+"""RMSprop — torch.optim.RMSprop parity, pure-pytree.
+
+The reference uses only SGD (/root/reference/mpspawn_dist.py:64,
+example_mp.py:84-90); RMSprop rounds out the torch.optim surface a
+reference user would reach for next (RNN-style workloads).
+
+Same pure-pytree contract as :class:`tpu_dist.optim.SGD`: ``init`` builds
+the state, ``update(grads, opt_state, params)`` is a pure function, so the
+whole update fuses into the jitted train step (and shards under the DDP
+wrapper's ZeRO-1 option, which is optimizer-agnostic).
+
+Update rule (torch semantics — eps is added AFTER the square root, and
+weight decay folds into the gradient before the moment update):
+
+    g   = g + wd * p
+    sa  = alpha * sa + (1 - alpha) * g^2
+    ga  = alpha * ga + (1 - alpha) * g          (centered only)
+    den = sqrt(sa - ga^2) + eps                 (sa alone if not centered)
+    buf = momentum * buf + g / den;  p -= lr * buf      (momentum > 0)
+    p  -= lr * g / den                                  (momentum == 0)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Union
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["RMSprop"]
+
+LrLike = Union[float, Callable[[jnp.ndarray], jnp.ndarray]]
+
+
+class RMSprop:
+    def __init__(self, lr: LrLike = 1e-2, alpha: float = 0.99,
+                 eps: float = 1e-8, weight_decay: float = 0.0,
+                 momentum: float = 0.0, centered: bool = False):
+        """``lr`` may be a float or a compiled-in schedule
+        (:mod:`tpu_dist.optim.lr_scheduler`)."""
+        if not 0.0 <= alpha < 1.0:
+            raise ValueError(f"Invalid alpha {alpha}")
+        if eps <= 0.0:
+            raise ValueError(f"Invalid eps {eps}")
+        if momentum < 0.0:
+            raise ValueError(f"Invalid momentum {momentum}")
+        self.lr = lr
+        self.alpha = alpha
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.momentum = momentum
+        self.centered = centered
+
+    def init(self, params) -> Dict[str, Any]:
+        zeros = lambda: jax.tree.map(jnp.zeros_like, params)
+        state: Dict[str, Any] = {"square_avg": zeros(),
+                                 "step": jnp.zeros((), jnp.int32)}
+        if self.momentum > 0.0:
+            state["momentum_buffer"] = zeros()
+        if self.centered:
+            state["grad_avg"] = zeros()
+        return state
+
+    def update(self, grads, opt_state, params):
+        """Return ``(new_params, new_opt_state)``; pure function."""
+        a = self.alpha
+        wd = self.weight_decay
+        lr = self.lr(opt_state["step"]) if callable(self.lr) else self.lr
+
+        if wd:
+            grads = jax.tree.map(lambda g, p: g + wd * p, grads, params)
+
+        new_sa = jax.tree.map(lambda s, g: a * s + (1.0 - a) * jnp.square(g),
+                              opt_state["square_avg"], grads)
+        new_state: Dict[str, Any] = {"square_avg": new_sa,
+                                     "step": opt_state["step"] + 1}
+
+        if self.centered:
+            new_ga = jax.tree.map(lambda m, g: a * m + (1.0 - a) * g,
+                                  opt_state["grad_avg"], grads)
+            new_state["grad_avg"] = new_ga
+            den = jax.tree.map(
+                lambda s, m: jnp.sqrt(s - jnp.square(m)) + self.eps,
+                new_sa, new_ga)
+        else:
+            den = jax.tree.map(lambda s: jnp.sqrt(s) + self.eps, new_sa)
+
+        if self.momentum > 0.0:
+            new_buf = jax.tree.map(
+                lambda b, g, d: self.momentum * b + g / d,
+                opt_state["momentum_buffer"], grads, den)
+            new_state["momentum_buffer"] = new_buf
+            new_params = jax.tree.map(lambda p, b: p - lr * b,
+                                      params, new_buf)
+        else:
+            new_params = jax.tree.map(lambda p, g, d: p - lr * g / d,
+                                      params, grads, den)
+        return new_params, new_state
+
+    def __repr__(self):
+        return (f"RMSprop(lr={self.lr}, alpha={self.alpha}, "
+                f"momentum={self.momentum}, centered={self.centered})")
