@@ -86,7 +86,7 @@ def _better(new: dict, old: dict) -> dict:
 def main() -> None:
     sys.path.insert(0, _REPO)
     from benchmarks import (attention, imagenet_e2e, input_pipeline, moe_lm,
-                            resnet_cifar, scaling, transformer_lm)
+                            resnet_cifar, scaling, transformer_lm, vit_train)
 
     out = os.path.join(_REPO, "BENCH_EXTENDED.json")
     previous = {}
@@ -107,6 +107,7 @@ def main() -> None:
         "lm_long": "transformer_lm_long_context_8k_bf16_tokens_per_sec_per_chip",
         "lm_32k": "transformer_lm_long_context_32k_bf16_tokens_per_sec_per_chip",
         "imagenet_e2e": "resnet50_imagenet_e2e_sustained_images_per_sec",
+        "vit_train": "vit_b16_imagenet_bf16_train_images_per_sec_per_chip",
     }
     results = []
     for name, fn in (("resnet_cifar", resnet_cifar.run),
@@ -117,7 +118,8 @@ def main() -> None:
                      ("moe_lm", moe_lm.run),
                      ("lm_long", transformer_lm.run_long),
                      ("lm_32k", transformer_lm.run_32k),
-                     ("imagenet_e2e", imagenet_e2e.run)):
+                     ("imagenet_e2e", imagenet_e2e.run),
+                     ("vit_train", vit_train.run)):
         try:
             r = fn()
         except Exception as e:  # record the failure, keep the rest running

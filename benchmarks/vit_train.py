@@ -1,0 +1,76 @@
+"""ViT-B/16 ImageNet-shape bf16 DDP training — images/sec/chip.
+
+The attention-era rung of the image ladder (next to resnet_cifar.py and
+the ResNet-50 rows): torchvision-parity ``vit_b_16`` (models/vit.py,
+86.6M params) at 224x224, trained through the same
+DistributedDataParallel bf16 fused step as every other workload.  The
+encoder reuses TransformerBlock, so the Pallas flash attention kernel is
+exercised at N=197 tokens — short-sequence attention, where the dense
+path is auto-selected (flash tiles start paying at longer T); the row
+therefore also pins the model-zoo claim that ViT trains through the
+standard stack with zero special-casing.
+
+AdamW lr 3e-4 (the ViT-family default; SGD diverges ViT from scratch).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def run(per_chip_batch: int = 64, steps: int = 20, reps: int = 3) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import tpu_dist.dist as dist
+    from tpu_dist import nn, optim
+    from tpu_dist.models import vit_b_16
+    from tpu_dist.parallel import DistributedDataParallel
+
+    from .timing import ddp_repeat_step_time
+
+    own_group = not dist.is_initialized()
+    pg = dist.init_process_group() if own_group else dist.get_default_group()
+    n_chips = dist.get_world_size()
+    batch = per_chip_batch * n_chips
+
+    ddp = DistributedDataParallel(
+        vit_b_16(num_classes=1000),
+        optimizer=optim.AdamW(lr=3e-4, weight_decay=0.05),
+        loss_fn=nn.CrossEntropyLoss(), group=pg, donate=True,
+        compute_dtype=jnp.bfloat16)
+
+    rng = np.random.default_rng(0)
+    sharding = NamedSharding(pg.mesh, P(pg.axis_name))
+    x = jax.device_put(
+        rng.normal(size=(batch, 224, 224, 3)).astype(np.float32), sharding)
+    y = jax.device_put(rng.integers(0, 1000, batch).astype(np.int32),
+                       sharding)
+
+    t = ddp_repeat_step_time(ddp, x, y, steps=steps, reps=reps)
+    # model FLOPs: 2*N_params per token forward (attention at N=197 adds
+    # ~2%, ignored), 197 tokens/image, fwd+bwd ~= 3x fwd
+    n_tokens = (224 // 16) ** 2 + 1
+    flops_per_image = 3 * 2 * 86_567_656 * n_tokens
+    result = {
+        "metric": "vit_b16_imagenet_bf16_train_images_per_sec_per_chip",
+        "value": round(batch / t / n_chips, 1),
+        "unit": "images/sec/chip",
+        "step_ms": round(t * 1e3, 3),
+        "per_chip_batch": per_chip_batch,
+        "achieved_model_tflops": round(batch / t / n_chips
+                                       * flops_per_image / 1e12, 2),
+        "n_chips": n_chips,
+    }
+    if own_group:
+        dist.destroy_process_group()
+    return result
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    print(json.dumps(run()))

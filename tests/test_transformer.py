@@ -179,6 +179,36 @@ class TestGenerate:
         with pytest.raises(ValueError, match="causal"):
             bidir.init_cache(batch=1)
 
+    def test_generate_topk_topp(self):
+        """top_k=1 and a vanishing top_p both collapse sampling to greedy;
+        wider settings sample only eligible tokens; bad values raise."""
+        model, params = self._model()
+        prompt = _tokens(b=2, t=4)
+        greedy = model.generate(params, prompt, 6)
+        for kw in (dict(top_k=1), dict(top_p=1e-9)):
+            out = model.generate(params, prompt, 6, temperature=1.0,
+                                 rng=jax.random.key(3), **kw)
+            np.testing.assert_array_equal(np.asarray(out),
+                                          np.asarray(greedy), err_msg=str(kw))
+        # top_k restricts every sampled continuation token to the k most
+        # probable ids of its step distribution: check the first sampled
+        # token over many draws
+        logits = model.apply(params, prompt)[:, -1]
+        k = 3
+        topk_ids = np.asarray(jax.lax.top_k(logits, k)[1])  # (B, k)
+        for seed in range(10):
+            out = model.generate(params, prompt, 1, temperature=2.0,
+                                 rng=jax.random.key(seed), top_k=k)
+            first = np.asarray(out[:, prompt.shape[1]])
+            for b in range(first.shape[0]):
+                assert first[b] in topk_ids[b], (seed, b)
+        with pytest.raises(ValueError, match="top_k"):
+            model.generate(params, prompt, 2, temperature=1.0,
+                           rng=jax.random.key(0), top_k=-2)
+        with pytest.raises(ValueError, match="top_p"):
+            model.generate(params, prompt, 2, temperature=1.0,
+                           rng=jax.random.key(0), top_p=0.0)
+
     def test_generate_zero_tokens_returns_prompt(self):
         model, params = self._model()
         prompt = _tokens(b=2, t=4)

@@ -35,15 +35,20 @@ class TransformerBlock(nn.Module):
     def __init__(self, dim: int, num_heads: int, causal: bool = True,
                  sequence_axis: Optional[str] = None, mode: str = "ring",
                  mlp: Optional[nn.Module] = None, norm: str = "layernorm",
-                 rope: bool = False, rope_theta: float = 10000.0):
+                 rope: bool = False, rope_theta: float = 10000.0,
+                 norm_eps: Optional[float] = None):
         super().__init__()
         norm_cls = _norm_cls(norm)
-        self.ln1 = norm_cls(dim)
+        # norm_eps=None keeps each norm class's own default (LayerNorm
+        # 1e-5, RMSNorm 1e-6); ViT passes 1e-6 for torchvision parity
+        mk_norm = (norm_cls if norm_eps is None
+                   else lambda d: norm_cls(d, eps=norm_eps))
+        self.ln1 = mk_norm(dim)
         self.attn = nn.MultiheadSelfAttention(dim, num_heads, causal=causal,
                                               sequence_axis=sequence_axis,
                                               mode=mode, rope=rope,
                                               rope_theta=rope_theta)
-        self.ln2 = norm_cls(dim)
+        self.ln2 = mk_norm(dim)
         # mlp override: e.g. an nn.MoELayer for mixture-of-experts blocks
         self.mlp = mlp if mlp is not None else nn.Sequential(
             nn.Linear(dim, 4 * dim), nn.GELU(), nn.Linear(4 * dim, dim))
@@ -184,16 +189,22 @@ class TransformerLM(nn.Module):
                              for i in range(self.depth))}
 
     def generate(self, params, prompt, max_new_tokens: int,
-                 temperature: float = 0.0, rng=None, cache_dtype=None):
+                 temperature: float = 0.0, rng=None, cache_dtype=None,
+                 top_k: int = 0, top_p: float = 1.0):
         """Autoregressive decoding with a KV cache.
 
         ``prompt``: int tokens (B, Tp).  Returns (B, Tp + max_new_tokens) —
         the prompt with the continuation appended.  ``temperature`` 0 is
-        greedy argmax; > 0 samples categorically (``rng`` required).  The
-        prompt is prefilled in ONE forward pass (cache index advances by
-        Tp), then each new token is one t=1 forward through the cache — the
-        whole loop is a ``lax.scan``, so generate() jits to a single XLA
-        program with no per-token dispatch.
+        greedy argmax; > 0 samples categorically (``rng`` required), with
+        optional truncation: ``top_k`` > 0 restricts sampling to the k
+        highest-probability tokens, ``top_p`` < 1 to the smallest set
+        whose cumulative probability reaches p (nucleus sampling; the
+        highest-probability token always stays eligible).  Both filters
+        are static-shape masks over the fixed vocab, so they trace into
+        the same single XLA program.  The prompt is prefilled in ONE
+        forward pass (cache index advances by Tp), then each new token is
+        one t=1 forward through the cache — the whole loop is a
+        ``lax.scan``, so generate() jits with no per-token dispatch.
         """
         b, tp = prompt.shape
         if max_new_tokens <= 0:
@@ -208,11 +219,29 @@ class TransformerLM(nn.Module):
                              f"({self.max_seq_len})")
         if temperature > 0 and rng is None:
             raise ValueError("temperature > 0 sampling requires rng=")
+        if top_k < 0 or top_k > self.vocab_size:
+            raise ValueError(f"top_k must be in [0, vocab_size], got "
+                             f"{top_k}")
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
 
         def sample(logits, key):
             if temperature <= 0:
                 return logits.argmax(-1)
-            return jax.random.categorical(key, logits / temperature, axis=-1)
+            logits = logits / temperature
+            if top_k:
+                kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+                logits = jnp.where(logits < kth, -jnp.inf, logits)
+            if top_p < 1.0:
+                desc = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
+                probs = jax.nn.softmax(desc, axis=-1)
+                # keep tokens whose cumulative probability BEFORE them is
+                # < p: the argmax token (exclusive cumsum 0) always stays
+                keep = (jnp.cumsum(probs, axis=-1) - probs) < top_p
+                thresh = jnp.min(jnp.where(keep, desc, jnp.inf),
+                                 axis=-1, keepdims=True)
+                logits = jnp.where(logits < thresh, -jnp.inf, logits)
+            return jax.random.categorical(key, logits, axis=-1)
 
         cache = self.init_cache(b, total, cache_dtype or jnp.float32)
         logits, cache = self.apply(params, prompt, state=cache)

@@ -1,0 +1,139 @@
+"""Vision Transformer — torchvision ``vit_b_16``-family parity, NHWC.
+
+The reference's model zoo is torchvision (``torchvision.models.resnet18``
+at /root/reference/example_mp.py:50); ViT rounds out the same zoo for the
+attention era, reusing the framework's own pieces end to end: the patch
+embedding is :class:`~tpu_dist.nn.Conv2d` (NHWC, stride = patch), the
+encoder is the same pre-LN :class:`~tpu_dist.models.TransformerBlock` the
+LM uses (so ViT inherits flash attention on TPU automatically), and the
+classification head is a plain :class:`~tpu_dist.nn.Linear`.
+
+Parity points (torchvision ``VisionTransformer``):
+
+- architecture and parameter counts match exactly (``vit_b_16`` =
+  86,567,656 params at 1000 classes — verified in tests/test_models.py
+  against the published torchvision counts);
+- class token prepended to the patch sequence, learned position
+  embeddings over ``num_patches + 1`` positions, encoder LayerNorm eps
+  1e-6, final LayerNorm before the head;
+- init follows torchvision: zeros class token, N(0, 0.02) position
+  embeddings, zero-initialized head.
+
+Layout is NHWC throughout (TPU-native; torchvision is NCHW) — images are
+``(B, H, W, 3)`` like every other model here.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from .transformer import TransformerBlock
+
+__all__ = ["VisionTransformer", "vit_b_16", "vit_b_32", "vit_l_16",
+           "vit_l_32"]
+
+
+class _TokenEmbeddings(nn.Module):
+    """Class token + learned position table, one param path.
+
+    torchvision init semantics: ``class_token`` zeros, ``pos_embedding``
+    N(0, 0.02) (``VisionTransformer.__init__``'s ``normal_(std=0.02)``).
+    """
+
+    def __init__(self, seq_len: int, dim: int):
+        super().__init__()
+        self.seq_len = seq_len
+        self.dim = dim
+
+    def create_params(self, key):
+        return {"class_token": jnp.zeros((1, 1, self.dim)),
+                "pos_embedding": 0.02 * jax.random.normal(
+                    key, (1, self.seq_len, self.dim))}
+
+    def forward(self, x):
+        from ..nn.module import _ctx
+        p = _ctx().get_params(self._path)
+        b = x.shape[0]
+        cls = jnp.broadcast_to(p["class_token"].astype(x.dtype),
+                               (b, 1, x.shape[-1]))
+        x = jnp.concatenate([cls, x], axis=1)
+        return x + p["pos_embedding"].astype(x.dtype)
+
+
+class VisionTransformer(nn.Module):
+    """ViT encoder classifier: images (B, H, W, 3) → logits (B, classes).
+
+    Args mirror torchvision's ``VisionTransformer``: ``image_size`` must
+    be divisible by ``patch_size``; ``hidden_dim`` is the encoder width.
+    There is no ``mlp_dim`` argument — ``TransformerBlock`` fixes the MLP
+    hidden width at ``4 * hidden_dim``, which every standard ViT config
+    (B, L, H) satisfies.
+    """
+
+    def __init__(self, image_size: int = 224, patch_size: int = 16,
+                 num_layers: int = 12, num_heads: int = 12,
+                 hidden_dim: int = 768, num_classes: int = 1000):
+        super().__init__()
+        if image_size % patch_size:
+            raise ValueError(f"image_size {image_size} not divisible by "
+                             f"patch_size {patch_size}")
+        self.image_size = image_size
+        self.patch_size = patch_size
+        self.hidden_dim = hidden_dim
+        n_patches = (image_size // patch_size) ** 2
+        self.conv_proj = nn.Conv2d(3, hidden_dim, patch_size,
+                                   stride=patch_size)
+        self.tokens = _TokenEmbeddings(n_patches + 1, hidden_dim)
+        for i in range(num_layers):
+            setattr(self, f"block{i}", TransformerBlock(
+                hidden_dim, num_heads, causal=False, norm_eps=1e-6))
+        self.num_layers = num_layers
+        self.ln = nn.LayerNorm(hidden_dim, eps=1e-6)
+        self.head = nn.Linear(hidden_dim, num_classes)
+
+    def forward(self, x):
+        b, h, w, c = x.shape
+        if (h, w, c) != (self.image_size, self.image_size, 3):
+            raise ValueError(f"expected (B, {self.image_size}, "
+                             f"{self.image_size}, 3) NHWC images, got "
+                             f"{x.shape}")
+        x = self.conv_proj(x)                      # (B, H/p, W/p, d)
+        x = x.reshape(b, -1, self.hidden_dim)      # (B, N, d)
+        x = self.tokens(x)                         # (B, N+1, d)
+        for i in range(self.num_layers):
+            x = getattr(self, f"block{i}")(x)
+        x = self.ln(x)
+        return self.head(x[:, 0])                  # class token only
+
+    def init(self, key):
+        params = super().init(key)
+        # torchvision zero-initializes the classification head
+        params["head"]["weight"] = jnp.zeros_like(params["head"]["weight"])
+        params["head"]["bias"] = jnp.zeros_like(params["head"]["bias"])
+        return params
+
+
+def vit_b_16(num_classes: int = 1000, image_size: int = 224):
+    """ViT-Base/16 (torchvision ``vit_b_16``: 86,567,656 params @ 1000)."""
+    return VisionTransformer(image_size, 16, 12, 12, 768,
+                             num_classes=num_classes)
+
+
+def vit_b_32(num_classes: int = 1000, image_size: int = 224):
+    """ViT-Base/32 (torchvision ``vit_b_32``: 88,224,232 params @ 1000)."""
+    return VisionTransformer(image_size, 32, 12, 12, 768,
+                             num_classes=num_classes)
+
+
+def vit_l_16(num_classes: int = 1000, image_size: int = 224):
+    """ViT-Large/16 (torchvision ``vit_l_16``: 304,326,632 params @ 1000)."""
+    return VisionTransformer(image_size, 16, 24, 16, 1024,
+                             num_classes=num_classes)
+
+
+def vit_l_32(num_classes: int = 1000, image_size: int = 224):
+    """ViT-Large/32 (torchvision ``vit_l_32``: 306,535,400 params @ 1000)."""
+    return VisionTransformer(image_size, 32, 24, 16, 1024,
+                             num_classes=num_classes)
