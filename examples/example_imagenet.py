@@ -16,6 +16,10 @@ Data: ``--imagefolder PATH`` trains from an on-disk
 ``root/<class>/<img>`` tree (real ImageNet layout); default is the
 deterministic SyntheticImageNet stand-in, which keeps the example hermetic
 in egress-less environments.
+
+``--model vit_b_16`` swaps the trunk for the torchvision-parity ViT-B/16
+(models/vit.py) with its AdamW recipe — same sampler, augmentation, and
+DDP step; the attention era rides the identical pipeline.
 """
 
 import argparse
@@ -38,6 +42,11 @@ def main():
     parser.add_argument("--backend", default="tpu", choices=["tpu", "cpu"])
     parser.add_argument("--imagefolder", default=None, type=str,
                         help="ImageFolder root (default: synthetic ImageNet)")
+    parser.add_argument("--model", default="resnet50",
+                        choices=["resnet50", "vit_b_16"],
+                        help="resnet50 (SGD .1/.9/1e-4, the ladder recipe) "
+                             "or vit_b_16 (AdamW 3e-4/wd .05 — SGD "
+                             "diverges ViT from scratch)")
     parser.add_argument("--image-size", default=224, type=int)
     parser.add_argument("--num-classes", default=1000, type=int)
     parser.add_argument("--synthetic-size", default=2048, type=int)
@@ -67,7 +76,7 @@ def main():
     from tpu_dist import nn, optim
     from tpu_dist.data import (DataLoader, DeviceLoader, DistributedSampler,
                                ImageFolder, SyntheticImageNet, transforms)
-    from tpu_dist.models import resnet50
+    from tpu_dist.models import resnet50, vit_b_16
     from tpu_dist.parallel import DistributedDataParallel
 
     init_method = args.dist_url
@@ -102,9 +111,18 @@ def main():
                                transform=host_aug)
         num_classes = args.num_classes
 
+    if args.model == "vit_b_16":
+        if args.image_size % 16:
+            parser.error("--model vit_b_16 needs --image-size divisible "
+                         "by 16")
+        model = vit_b_16(num_classes=num_classes,
+                         image_size=args.image_size)
+        optimizer = optim.AdamW(lr=3e-4, weight_decay=0.05)
+    else:
+        model = resnet50(num_classes=num_classes)
+        optimizer = optim.SGD(lr=0.1, momentum=0.9, weight_decay=1e-4)
     ddp = DistributedDataParallel(
-        resnet50(num_classes=num_classes),
-        optimizer=optim.SGD(lr=0.1, momentum=0.9, weight_decay=1e-4),
+        model, optimizer=optimizer,
         loss_fn=nn.CrossEntropyLoss(), group=pg,
         sync_batchnorm=args.sync_bn,
         compute_dtype=None if args.no_bf16 else jnp.bfloat16)
