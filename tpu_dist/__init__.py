@@ -22,8 +22,8 @@ TPU.  Currently shipped subpackages:
 
 __version__ = "0.1.0"
 
-from . import (checkpoint, collectives, data, dist, models, nn, optim,
-               parallel, utils)
+from . import (checkpoint, collectives, data, dist, interop, models, nn,
+               optim, parallel, utils)
 
 __all__ = ["nn", "optim", "models", "dist", "collectives", "data",
-           "parallel", "checkpoint", "utils", "__version__"]
+           "parallel", "checkpoint", "utils", "interop", "__version__"]

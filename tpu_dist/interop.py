@@ -1,0 +1,277 @@
+"""torch checkpoint interop — load/export ``state_dict`` weights.
+
+The reference user's checkpoints are torch ``state_dict``s (torchvision
+``resnet18`` at /root/reference/example_mp.py:50, the tutorial ConvNet at
+/root/reference/mpspawn_dist.py:11-43).  tpu_dist's module paths
+deliberately mirror torch naming (``layer1.0.conv1``, ``fc``, ...), so a
+torch checkpoint loads by aligning paths and re-laying-out each leaf:
+
+====================  ==========================  =======================
+module                torch layout                tpu_dist layout
+====================  ==========================  =======================
+Conv2d weight         (O, I/g, kh, kw)            (kh, kw, I/g, O)
+Linear weight         (out, in)                   (in, out)
+MultiheadSelfAttn     in_proj_weight (3d, d)      qkv_weight (d, 3d)
+                      out_proj.weight (d, d)      out_weight (d, d), .T
+BatchNorm running_*   buffers in state_dict       mutable-state ``mean`` /
+                                                  ``var`` pytree
+everything else       identical                   identical
+====================  ==========================  =======================
+
+``load_torch_state_dict`` returns ``(params, model_state)`` ready for
+``apply()``/DDP; ``to_torch_state_dict`` is the exact inverse, so a model
+trained here can resume in torch.  Transforms are selected by MODULE
+CLASS (not by shape heuristics — a square Linear weight would otherwise
+be ambiguous).  ``torch.Tensor`` leaves and plain numpy arrays are both
+accepted; nothing here imports torch.
+
+For architectures whose torch naming differs structurally, pass
+``key_map`` (our-key → torch-key); :func:`vit_torchvision_key_map`
+generates it for torchvision ``VisionTransformer`` checkpoints.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Tuple, Union
+
+import jax
+import numpy as np
+
+__all__ = ["load_torch_state_dict", "to_torch_state_dict",
+           "vit_torchvision_key_map", "flatten_linear_from_torch",
+           "flatten_linear_to_torch"]
+
+# torch buffers with no tpu_dist counterpart, silently ignored
+_IGNORED_SUFFIXES = ("num_batches_tracked",)
+
+# our attention leaf name -> torch nn.MultiheadAttention sub-key
+_ATTN_LEAF_TO_TORCH = {"qkv_weight": "in_proj_weight",
+                       "qkv_bias": "in_proj_bias",
+                       "out_weight": "out_proj.weight",
+                       "out_bias": "out_proj.bias"}
+_STATE_LEAF_TO_TORCH = {"mean": "running_mean", "var": "running_var"}
+
+
+def _np(x) -> np.ndarray:
+    """Accept torch tensors, jax arrays, and numpy arrays."""
+    if hasattr(x, "detach"):                      # torch.Tensor
+        x = x.detach().cpu()
+        try:
+            x = x.numpy()
+        except TypeError:
+            # dtypes numpy can't hold (bf16 checkpoints): upcast; the
+            # caller casts to the target leaf dtype afterwards anyway
+            x = x.float().numpy()
+    return np.asarray(x)
+
+
+def _join(path: str, leaf: str) -> str:
+    return f"{path}.{leaf}" if path else leaf
+
+
+def _module_kinds(model) -> Dict[str, str]:
+    """Map each param path to a transform kind by module class."""
+    from . import nn
+
+    kinds = {}
+    model._assign_paths()
+    for path, mod in model.named_modules():
+        if isinstance(mod, nn.Conv2d):
+            kinds[path] = "conv"
+        elif isinstance(mod, nn.Linear):
+            kinds[path] = "linear"
+        elif isinstance(mod, nn.MultiheadSelfAttention):
+            kinds[path] = "attn"
+        else:
+            kinds[path] = "direct"
+    return kinds
+
+
+def _torch_key(path: str, leaf: str, kind: str) -> str:
+    if kind == "attn":
+        return _join(path, _ATTN_LEAF_TO_TORCH.get(leaf, leaf))
+    return _join(path, leaf)
+
+
+def _to_ours(kind: str, leaf: str, t: np.ndarray) -> np.ndarray:
+    if kind == "conv" and leaf == "weight":
+        return np.transpose(t, (2, 3, 1, 0))
+    if kind == "linear" and leaf == "weight":
+        return np.transpose(t)
+    if kind == "attn" and leaf in ("qkv_weight", "out_weight"):
+        return np.transpose(t)
+    return t
+
+
+def _to_torch(kind: str, leaf: str, a: np.ndarray) -> np.ndarray:
+    if kind == "conv" and leaf == "weight":
+        return np.transpose(a, (3, 2, 0, 1))
+    if kind == "linear" and leaf == "weight":
+        return np.transpose(a)
+    if kind == "attn" and leaf in ("qkv_weight", "out_weight"):
+        return np.transpose(a)
+    return a
+
+
+def flatten_linear_from_torch(c: int, h: int, w: int) -> Callable:
+    """Transform for a Linear whose input is a FLATTENED conv feature map.
+
+    torch flattens NCHW — the weight's input dim is ordered (C, H, W);
+    tpu_dist flattens NHWC — (H, W, C).  A plain transpose would silently
+    scramble those columns (outputs wrong, shapes fine), so such leaves
+    need this as a per-key ``transforms`` entry, e.g.::
+
+        interop.load_torch_state_dict(model, sd, transforms={
+            "fc1.weight": interop.flatten_linear_from_torch(128, 4, 4)})
+
+    Not needed when the flatten is preceded by global pooling to 1x1
+    (ResNet's avgpool) — the input dim is then pure channels.
+    """
+    def f(t: np.ndarray) -> np.ndarray:
+        out = t.shape[0]
+        return (t.reshape(out, c, h, w).transpose(2, 3, 1, 0)
+                .reshape(h * w * c, out))
+    return f
+
+
+def flatten_linear_to_torch(c: int, h: int, w: int) -> Callable:
+    """Inverse of :func:`flatten_linear_from_torch` (for export)."""
+    def f(a: np.ndarray) -> np.ndarray:
+        out = a.shape[1]
+        return (a.reshape(h, w, c, out).transpose(3, 2, 0, 1)
+                .reshape(out, c * h * w))
+    return f
+
+
+KeyMap = Union[Dict[str, str], Callable[[str], str]]
+
+
+def _map_key(key: str, key_map: Optional[KeyMap]) -> str:
+    if key_map is None:
+        return key
+    if callable(key_map):
+        return key_map(key)
+    return key_map.get(key, key)
+
+
+def load_torch_state_dict(model, state_dict, key_map: Optional[KeyMap] = None,
+                          strict: bool = True, seed: int = 0, dtype=None,
+                          transforms: Optional[Dict[str, Callable]] = None,
+                          ) -> Tuple[dict, dict]:
+    """Build ``(params, model_state)`` for ``model`` from a torch
+    ``state_dict`` (a mapping of dotted names to tensors/arrays).
+
+    ``key_map``: optional our-key → torch-key translation (dict or
+    callable), applied AFTER the built-in attention-name mapping.
+    ``strict=True`` (torch semantics) raises ``KeyError`` listing missing
+    and unexpected keys; ``strict=False`` leaves missing leaves at their
+    seeded init values and ignores extras.  ``dtype``: optional cast for
+    the imported param leaves (e.g. ``jnp.bfloat16``).  ``transforms``:
+    per-our-key layout overrides replacing the class-based default — see
+    :func:`flatten_linear_from_torch` for the case that needs one.
+    """
+    import jax.numpy as jnp
+
+    params = model.init(jax.random.key(seed))
+    state = model.init_state()
+    kinds = _module_kinds(model)
+    sd = dict(state_dict)
+    transforms = transforms or {}
+    missing = []
+
+    def fill(tree, leaf_map, is_state):
+        for path, leaves in tree.items():
+            kind = kinds.get(path, "direct")
+            for leaf in leaves:
+                if is_state and leaf not in leaf_map:
+                    continue  # no torch analogue (e.g. MoE aux_loss)
+                name = leaf_map.get(leaf, leaf) if is_state else leaf
+                key = _map_key(_torch_key(path, name, kind), key_map)
+                if key not in sd:
+                    missing.append(key)
+                    continue
+                t = _np(sd.pop(key))
+                ours_key = _join(path, leaf)
+                if ours_key in transforms:
+                    a = transforms[ours_key](t)
+                else:
+                    a = t if is_state else _to_ours(kind, leaf, t)
+                want = tuple(leaves[leaf].shape)
+                if tuple(a.shape) != want:
+                    raise ValueError(
+                        f"{key}: torch shape {tuple(t.shape)} does not "
+                        f"map to {_join(path, leaf)} {want}")
+                cast = leaves[leaf].dtype if (is_state or dtype is None) \
+                    else dtype
+                leaves[leaf] = jnp.asarray(a, cast)
+
+    fill(params, {}, is_state=False)
+    fill(state, _STATE_LEAF_TO_TORCH, is_state=True)
+
+    unexpected = [k for k in sd
+                  if not k.endswith(_IGNORED_SUFFIXES)]
+    if strict and (missing or unexpected):
+        raise KeyError(
+            f"state_dict does not match model: missing keys {missing}, "
+            f"unexpected keys {unexpected}")
+    return params, state
+
+
+def to_torch_state_dict(model, params, model_state=None,
+                        key_map: Optional[KeyMap] = None,
+                        transforms: Optional[Dict[str, Callable]] = None,
+                        ) -> Dict[str, np.ndarray]:
+    """Inverse of :func:`load_torch_state_dict`: export ``params`` (+
+    optional BN ``model_state``) as a torch-layout ``state_dict`` of numpy
+    arrays (``torch.load``-compatible after ``torch.as_tensor``).
+    ``transforms`` overrides are keyed by OUR key like on load — use the
+    ``*_to_torch`` direction of each helper."""
+    kinds = _module_kinds(model)
+    transforms = transforms or {}
+    out: Dict[str, np.ndarray] = {}
+    for path, leaves in params.items():
+        kind = kinds.get(path, "direct")
+        for leaf, a in leaves.items():
+            key = _map_key(_torch_key(path, leaf, kind), key_map)
+            ours_key = _join(path, leaf)
+            if ours_key in transforms:
+                out[key] = transforms[ours_key](_np(a))
+            else:
+                out[key] = _to_torch(kind, leaf, _np(a))
+    for path, leaves in (model_state or {}).items():
+        for leaf, a in leaves.items():
+            if leaf not in _STATE_LEAF_TO_TORCH:
+                continue  # no torch analogue (e.g. MoE aux_loss)
+            out[_map_key(_join(path, _STATE_LEAF_TO_TORCH[leaf]),
+                         key_map)] = _np(a)
+    return out
+
+
+def vit_torchvision_key_map(num_layers: int) -> Dict[str, str]:
+    """our-key → torchvision ``VisionTransformer`` state_dict key, for
+    :class:`tpu_dist.models.VisionTransformer` (models/vit.py).
+
+    torchvision structure: encoder blocks live under
+    ``encoder.layers.encoder_layer_{i}`` with ``ln_1``/``self_attention``/
+    ``ln_2``/``mlp`` (MLPBlock indexes its Linears 0 and 3), the final norm
+    is ``encoder.ln``, the head ``heads.head``.
+    """
+    m = {"tokens.class_token": "class_token",
+         "tokens.pos_embedding": "encoder.pos_embedding",
+         "ln.weight": "encoder.ln.weight",
+         "ln.bias": "encoder.ln.bias",
+         "head.weight": "heads.head.weight",
+         "head.bias": "heads.head.bias"}
+    for i in range(num_layers):
+        src = f"block{i}"
+        dst = f"encoder.layers.encoder_layer_{i}"
+        for ours, theirs in (("ln1", "ln_1"), ("ln2", "ln_2")):
+            for w in ("weight", "bias"):
+                m[f"{src}.{ours}.{w}"] = f"{dst}.{theirs}.{w}"
+        for sub in ("in_proj_weight", "in_proj_bias", "out_proj.weight",
+                    "out_proj.bias"):
+            m[f"{src}.attn.{sub}"] = f"{dst}.self_attention.{sub}"
+        for ours, theirs in (("0", "0"), ("2", "3")):
+            for w in ("weight", "bias"):
+                m[f"{src}.mlp.{ours}.{w}"] = f"{dst}.mlp.{theirs}.{w}"
+    return m
