@@ -82,6 +82,15 @@ def main():
                    help="ep only: expert count (rounded up to a multiple "
                         "of the 'expert' axis size)")
     p.add_argument("--log-every", default=20, type=int)
+    p.add_argument("--generate", default=0, type=int,
+                   help="after dp training: sample N tokens with the KV "
+                        "cache and report how many transitions follow the "
+                        "learned permutation (greedy at the default "
+                        "--gen-temperature 0; --gen-top-k/--gen-top-p "
+                        "apply only when --gen-temperature > 0)")
+    p.add_argument("--gen-temperature", default=0.0, type=float)
+    p.add_argument("--gen-top-k", default=0, type=int)
+    p.add_argument("--gen-top-p", default=1.0, type=float)
     args = p.parse_args()
 
     if args.backend == "cpu":
@@ -95,6 +104,7 @@ def main():
         jax.config.update("jax_platforms", "cpu")
 
     import jax
+    import jax.numpy as jnp
     import numpy as np
     from jax import lax
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -136,6 +146,25 @@ def main():
             if dist.get_rank() == 0 and (i + 1) % args.log_every == 0:
                 print(f"Step [{i + 1}/{args.steps}] "
                       f"loss: {float(metrics['loss']):.4f}")
+
+        if args.generate > 0 and dist.get_rank() == 0:
+            # the trained map is y[t] = perm[x[t]], so greedy decoding
+            # iterates the permutation: each new token should be
+            # perm[previous] — a self-checking generation demo
+            prompt = jnp.asarray(rng.integers(0, args.vocab, (1, 4)))
+            out = model.generate(
+                state.params, prompt, args.generate,
+                temperature=args.gen_temperature,
+                rng=(jax.random.key(1) if args.gen_temperature > 0
+                     else None),
+                top_k=args.gen_top_k, top_p=args.gen_top_p)
+            seq = np.asarray(out[0])
+            gen = seq[prompt.shape[1] - 1:]
+            ok = sum(int(gen[i + 1]) == int(perm[gen[i]])
+                     for i in range(len(gen) - 1))
+            print(f"generate: {seq.tolist()}")
+            print(f"permutation-consistent transitions: "
+                  f"{ok}/{len(gen) - 1}")
 
     elif args.parallel == "sp":
         n = len(jax.devices())

@@ -18,6 +18,9 @@ import jax.numpy as jnp
 from tpu_dist import interop, nn
 from tpu_dist.models import ConvNet, VisionTransformer
 
+# compile-heavy (ViT/ConvNet forwards): excluded from the fast tier
+pytestmark = pytest.mark.slow
+
 
 class TorchConvNet(torch.nn.Module):
     """The tutorial MNIST ConvNet (SURVEY.md §2a #1) in torch, with the
