@@ -5,8 +5,10 @@ at /root/reference/example_mp.py:50); ViT rounds out the same zoo for the
 attention era, reusing the framework's own pieces end to end: the patch
 embedding is :class:`~tpu_dist.nn.Conv2d` (NHWC, stride = patch), the
 encoder is the same pre-LN :class:`~tpu_dist.models.TransformerBlock` the
-LM uses (so ViT inherits flash attention on TPU automatically), and the
-classification head is a plain :class:`~tpu_dist.nn.Linear`.
+LM uses (attention auto-dispatch picks the XLA-fused dense path at ViT's
+197-token sequence — measured 1.5x faster than the flash kernel there,
+see nn/attention.py ``_FLASH_MIN_SEQ``), and the classification head is a
+plain :class:`~tpu_dist.nn.Linear`.
 
 Parity points (torchvision ``VisionTransformer``):
 
