@@ -101,3 +101,107 @@ def test_two_process_training_and_eval(tmp_path):
     assert "Training complete" in r.stdout
     assert "Test: loss" in r.stdout
     assert "(10000 samples)" in r.stdout
+
+
+_MB_WORKER = textwrap.dedent("""
+    import json, os, sys, time
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import tpu_dist.dist as dist
+
+    pg = dist.init_process_group(backend="cpu", init_method="env://")
+    rank = dist.get_rank()
+    out = {"rank": rank}
+
+    # 1) both arrive: returns on every rank
+    dist.monitored_barrier(timeout=60)
+    out["barrier_ok"] = True
+
+    # 2) rank 1 skips the second barrier: rank 0 must time out AND name it
+    if rank == 0:
+        try:
+            dist.monitored_barrier(timeout=2)
+            out["second"] = "unexpected-success"
+        except RuntimeError as e:
+            out["second"] = str(e)
+    with open(sys.argv[1] + f"/mb{rank}.json", "w") as f:
+        json.dump(out, f)
+    dist.destroy_process_group()
+""")
+
+
+def test_monitored_barrier_two_processes(tmp_path):
+    """c10d monitored_barrier parity: passes when everyone arrives, and on
+    timeout process 0's error NAMES the missing rank."""
+    import os
+    script = tmp_path / "mb_worker.py"
+    script.write_text(_MB_WORKER)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_dist.launch", "--nproc_per_node=2",
+         "--master_port=0", str(script), str(tmp_path)],
+        cwd="/root/repo", env=env, capture_output=True, text=True,
+        timeout=300)
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    with open(tmp_path / "mb0.json") as f:
+        res0 = json.load(f)
+    with open(tmp_path / "mb1.json") as f:
+        res1 = json.load(f)
+    assert res0["barrier_ok"] and res1["barrier_ok"]
+    assert "[1]" in res0["second"] and "did not reach" in res0["second"]
+
+
+def test_monitored_barrier_single_process_noop():
+    import tpu_dist.dist as dist
+    pg = dist.init_process_group(backend="cpu")
+    try:
+        dist.monitored_barrier()  # no store needed single-process
+    finally:
+        dist.destroy_process_group()
+
+
+_ABORT_WORKER = textwrap.dedent("""
+    import os, sys, time
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import tpu_dist.dist as dist
+
+    pg = dist.init_process_group(backend="cpu", init_method="env://")
+    rank = dist.get_rank()
+    if rank == 1:
+        time.sleep(600)       # simulated hang
+    try:
+        dist.monitored_barrier(timeout=3)
+    except RuntimeError as e:
+        print(f"diagnosis: {e}", flush=True)
+        dist.abort(7)
+""")
+
+
+def test_abort_breaks_hung_world_fail_fast(tmp_path):
+    """The NCCL-error-handling story: a hung peer is diagnosed by
+    monitored_barrier and escaped with dist.abort — the launcher reaps
+    the abort code and kills the hung rank within seconds.  (sys.exit
+    would hang instead: jax.distributed's atexit shutdown barrier waits
+    on the very peer that is hung — see dist.abort's docstring.)"""
+    import os
+    import time as _time
+    script = tmp_path / "abort_worker.py"
+    script.write_text(_ABORT_WORKER)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    t0 = _time.monotonic()
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_dist.launch", "--nproc_per_node=2",
+         "--master_port=0", str(script)],
+        cwd="/root/repo", env=env, capture_output=True, text=True,
+        timeout=120)
+    elapsed = _time.monotonic() - t0
+    assert r.returncode == 7, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    assert "diagnosis:" in r.stdout and "[1]" in r.stdout
+    assert elapsed < 90, f"fail-fast took {elapsed:.0f}s"

@@ -5,7 +5,8 @@ The c10d equivalent: ``init_process_group`` and friends
 TPU topology (one process per host, a mesh of cores, XLA collectives).
 """
 
-from .process_group import (DATA_AXIS, ProcessGroup, barrier,
+from .process_group import (DATA_AXIS, ProcessGroup, abort, barrier,
+                            monitored_barrier,
                             destroy_process_group, get_backend,
                             get_default_group, get_local_rank,
                             get_local_world_size, get_num_processes,
@@ -20,7 +21,7 @@ __all__ = [
     "is_initialized", "get_default_group", "get_world_size", "get_rank",
     "get_backend",
     "get_local_rank", "get_local_world_size", "get_num_processes",
-    "new_group", "barrier", "DATA_AXIS",
+    "new_group", "barrier", "monitored_barrier", "abort", "DATA_AXIS",
     "rendezvous", "parse_init_method",
     "Store", "TCPStore", "FileStore", "ReduceOp",
 ]

@@ -63,6 +63,8 @@ __all__ = [
     "get_num_processes",
     "new_group",
     "barrier",
+    "monitored_barrier",
+    "abort",
     "DATA_AXIS",
 ]
 
@@ -317,6 +319,102 @@ def barrier(group: Optional[ProcessGroup] = None) -> None:
         return
     from jax.experimental import multihost_utils
     multihost_utils.sync_global_devices("tpu_dist.barrier")
+
+
+_MB_SEQ = [0]  # per-process monitored_barrier call counter (all processes
+               # must call it in the same order, like every collective)
+
+
+def monitored_barrier(group: Optional[ProcessGroup] = None,
+                      timeout: float = 300.0) -> None:
+    """Barrier that NAMES the ranks that failed to arrive (torch
+    ``dist.monitored_barrier`` parity — its debugging use-case is finding
+    the hung rank in a deadlocked job).
+
+    Each process posts an arrival key on the control-plane store; process
+    0 collects them under ``timeout`` seconds and raises ``RuntimeError``
+    listing every missing process rank (c10d's ``wait_all_ranks=True``
+    behavior — all stragglers, not just the first), then publishes the
+    release key the others wait on.  No-op single-process; raises
+    ``RuntimeError`` when the job has no control-plane store (pure
+    ``tcp://``-less bring-up) — fall back to :func:`barrier` there.
+
+    Default-group only (like :func:`barrier`'s global sync): a subgroup's
+    process membership is not tracked against store keys, so passing one
+    raises rather than produce a wrong diagnosis.
+    """
+    g = _group(group)
+    if getattr(g, "parent", None) is not None:
+        raise ValueError("monitored_barrier supports the default group "
+                         "only (a subgroup diagnosis would misname "
+                         "non-member ranks as missing)")
+    if g.num_processes <= 1:
+        return
+    store = _rdzv._store
+    if store is None:
+        raise RuntimeError(
+            "monitored_barrier needs the control-plane store (launcher or "
+            "env:// / tcp:// bring-up); use dist.barrier() instead")
+    seq = _MB_SEQ[0]
+    _MB_SEQ[0] += 1
+    rank = get_rank()
+    n = g.num_processes
+    prefix = f"__monitored_barrier__/{seq}"
+    store.set(f"{prefix}/arrived/{rank}", b"1")
+    import time as _time
+    deadline = _time.monotonic() + timeout
+    if rank == 0:
+        missing = list(range(1, n))
+        while missing and _time.monotonic() < deadline:
+            missing = [r for r in missing
+                       if not store.check(f"{prefix}/arrived/{r}")]
+            if missing:
+                _time.sleep(0.01)
+        if missing:
+            raise RuntimeError(
+                f"monitored_barrier timed out after {timeout}s; process "
+                f"rank(s) {missing} did not reach the barrier")
+        store.set(f"{prefix}/go", b"1")
+    else:
+        try:
+            store.wait([f"{prefix}/go"],
+                       timeout=max(deadline - _time.monotonic(), 0.0))
+        except TimeoutError:
+            raise RuntimeError(
+                f"monitored_barrier timed out after {timeout}s waiting "
+                f"for process 0's release") from None
+
+
+def abort(exit_code: int = 1, reason: str = "") -> None:
+    """Terminate this process IMMEDIATELY without distributed teardown
+    (torch ``ProcessGroup.abort`` / NCCL error-handling parity).
+
+    Why it exists: ``sys.exit`` after a distributed failure can HANG —
+    jax.distributed's atexit shutdown runs a peer barrier, so a process
+    exiting because a *peer* is hung blocks on that same hung peer, the
+    launcher sees every child still alive, and fail-fast never fires
+    (measured: a worker that raised on :func:`monitored_barrier` timeout
+    then ``sys.exit(7)``-ed kept the whole world up for the coordination
+    service's multi-minute shutdown timeout).  ``abort`` flushes stdio and
+    ``os._exit``-s, so the launcher reaps the exit code at once and kills
+    the rest of the world.  Use it in except-handlers around collectives::
+
+        try:
+            dist.monitored_barrier(timeout=60)
+        except RuntimeError as e:
+            print(e, file=sys.stderr)
+            dist.abort(7)
+    """
+    import sys as _sys
+
+    if reason:
+        print(f"tpu_dist.abort: {reason}", file=_sys.stderr)
+    try:
+        _sys.stdout.flush()
+        _sys.stderr.flush()
+    except Exception:
+        pass
+    os._exit(exit_code)
 
 
 def destroy_process_group(group: Optional[ProcessGroup] = None) -> None:
