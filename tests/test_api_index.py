@@ -1,0 +1,46 @@
+"""docs/API.md completeness: every public name is indexed.
+
+The index claims to cover every ``__all__`` across the whole package;
+this test makes the claim mechanical, so API additions fail loudly until
+documented.  Coverage is by identifier token (not raw substring — a name
+appearing only inside a longer identifier or prose word does not count),
+over every importable submodule except ``__main__`` scripts, underscore
+modules, and the ``tpu_dist.run`` alias.
+"""
+
+import importlib
+import os
+import pkgutil
+import re
+
+import pytest
+
+_DOC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                    "docs", "API.md")
+
+
+def _modules():
+    import tpu_dist
+
+    mods = ["tpu_dist"]
+    for info in pkgutil.walk_packages(tpu_dist.__path__, prefix="tpu_dist."):
+        parts = info.name.split(".")
+        if any(p.startswith("_") for p in parts[1:]):
+            continue  # private modules and __main__ scripts (which exec)
+        if info.name == "tpu_dist.run":
+            continue  # torchrun-style alias: importing is fine, but it is
+            # documented as a CLI, not an API module
+        mods.append(info.name)
+    return mods
+
+
+@pytest.mark.parametrize("modname", _modules())
+def test_every_public_name_is_indexed(modname):
+    with open(_DOC) as f:
+        tokens = set(re.findall(r"[A-Za-z_][A-Za-z0-9_]*", f.read()))
+    mod = importlib.import_module(modname)
+    names = getattr(mod, "__all__", [])
+    missing = [n for n in names
+               if n not in tokens and not n.startswith("__")]
+    assert not missing, (f"{modname}.__all__ names missing from "
+                         f"docs/API.md: {missing}")

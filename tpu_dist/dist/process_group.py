@@ -344,7 +344,7 @@ def monitored_barrier(group: Optional[ProcessGroup] = None,
     raises rather than produce a wrong diagnosis.
     """
     g = _group(group)
-    if getattr(g, "parent", None) is not None:
+    if g._parent is not None:
         raise ValueError("monitored_barrier supports the default group "
                          "only (a subgroup diagnosis would misname "
                          "non-member ranks as missing)")
@@ -365,11 +365,12 @@ def monitored_barrier(group: Optional[ProcessGroup] = None,
     deadline = _time.monotonic() + timeout
     if rank == 0:
         missing = list(range(1, n))
-        while missing and _time.monotonic() < deadline:
+        while True:  # poll at least once: timeout=0 must not misdiagnose
             missing = [r for r in missing
                        if not store.check(f"{prefix}/arrived/{r}")]
-            if missing:
-                _time.sleep(0.01)
+            if not missing or _time.monotonic() >= deadline:
+                break
+            _time.sleep(0.01)
         if missing:
             raise RuntimeError(
                 f"monitored_barrier timed out after {timeout}s; process "
