@@ -146,6 +146,9 @@ def main() -> None:
         "imagenet_e2e": "resnet50_imagenet_e2e_sustained_images_per_sec",
         "vit_train": "vit_b16_imagenet_bf16_train_images_per_sec_per_chip",
         "generate": "transformer_lm_decode_tokens_per_sec",
+        "generate_int8": "transformer_lm_decode_int8_tokens_per_sec",
+        "gen_latency": "transformer_lm_decode_batch1_tokens_per_sec",
+        "gen_latency_int8": "transformer_lm_decode_batch1_int8_tokens_per_sec",
     }
     results = []
     for name, fn in (("resnet_cifar", resnet_cifar.run),
@@ -158,7 +161,10 @@ def main() -> None:
                      ("lm_32k", transformer_lm.run_32k),
                      ("imagenet_e2e", imagenet_e2e.run),
                      ("vit_train", vit_train.run),
-                     ("generate", generate.run)):
+                     ("generate", generate.run),
+                     ("generate_int8", generate.run_int8),
+                     ("gen_latency", generate.run_latency),
+                     ("gen_latency_int8", generate.run_latency_int8)):
         try:
             r = fn()
         except Exception as e:  # record the failure, keep the rest running

@@ -1,0 +1,143 @@
+"""Weight-only int8 quantization (nn/quant.py).
+
+Oracle: per-out-channel symmetric int8 bounds the weight error at
+scale/2 per element, so quantized logits must track full-precision
+logits closely; the converter must swap topology + params consistently
+and leave everything else (embeddings, norms, attention) untouched.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tpu_dist import nn
+from tpu_dist.models import TransformerLM
+
+
+def test_quantlinear_matches_linear_within_int8(rng):
+    """Direct numeric check: QuantLinear(q, scale) ≈ Linear(w) with the
+    per-out-channel error bound |w - q*scale| <= scale/2."""
+    from tpu_dist.nn.quant import _quantize_weight
+
+    lin = nn.Linear(64, 32)
+    p = lin.init(jax.random.key(0))
+    q, scale = _quantize_weight(p[""]["weight"])
+    assert q.dtype == np.int8 and scale.shape == (32,)
+    w = np.asarray(p[""]["weight"])
+    err = np.abs(w - q.astype(np.float32) * scale)
+    # bound is scale/2 at rounding ties; allow f32 arithmetic slack
+    assert (err <= scale / 2 * (1 + 1e-5) + 1e-7).all(), err.max()
+
+    qlin = nn.QuantLinear(64, 32)
+    qp = {"": {"q_weight": jnp.asarray(q), "scale": jnp.asarray(scale),
+               "bias": p[""]["bias"]}}
+    x = jnp.asarray(rng.standard_normal((8, 64)).astype(np.float32))
+    want = np.asarray(lin.apply(p, x))
+    got = np.asarray(qlin.apply(qp, x))
+    denom = max(np.abs(want).max(), 1e-6)
+    assert np.abs(got - want).max() / denom < 0.02
+
+    # a root-level bare Linear is not swappable (no parent): unchanged
+    same, same_p = nn.quantize_linear_weights(lin, p)
+    assert not isinstance(same, nn.QuantLinear)
+    assert "weight" in same_p[""]
+
+
+def test_converter_swaps_and_matches(rng):
+    class Net(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(50, 16)
+            self.fc1 = nn.Linear(16, 64)
+            self.act = nn.GELU()
+            self.fc2 = nn.Linear(64, 50)
+
+        def forward(self, idx):
+            h = self.act(self.fc1(self.emb(idx)))
+            return self.fc2(h)
+
+    net = Net()
+    params = net.init(jax.random.key(0))
+    x = jnp.asarray(rng.integers(0, 50, (4, 7)))
+    want = np.asarray(net.apply(params, x))
+
+    net, qparams = nn.quantize_linear_weights(net, params)
+    assert isinstance(net.fc1, nn.QuantLinear)
+    assert isinstance(net.fc2, nn.QuantLinear)
+    assert not isinstance(net.emb, nn.QuantLinear)
+    assert qparams["fc1"]["q_weight"].dtype == jnp.int8
+    assert "weight" not in qparams["fc1"]
+    assert qparams["emb"] is params["emb"]  # untouched leaf, same object
+
+    got = np.asarray(net.apply(qparams, x))
+    # int8 per-channel: logits track closely relative to their scale
+    denom = max(np.abs(want).max(), 1e-6)
+    assert np.abs(got - want).max() / denom < 0.02, \
+        np.abs(got - want).max()
+
+
+def test_skip_keeps_full_precision(rng):
+    class Net(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.a = nn.Linear(8, 8)
+            self.b = nn.Linear(8, 8)
+
+        def forward(self, x):
+            return self.b(self.a(x))
+
+    net = Net()
+    params = net.init(jax.random.key(0))
+    net, qp = nn.quantize_linear_weights(net, params, skip=["b"])
+    assert isinstance(net.a, nn.QuantLinear)
+    assert isinstance(net.b, nn.Linear) and not isinstance(net.b,
+                                                           nn.QuantLinear)
+    assert "weight" in qp["b"] and "q_weight" in qp["a"]
+
+
+def test_quantized_lm_generates(rng):
+    """The converted model drives the same generate() path; greedy tokens
+    from a trained-ish model stay consistent with full precision for a
+    short horizon."""
+    model = TransformerLM(vocab_size=40, dim=32, depth=2, num_heads=4,
+                          max_seq_len=32)
+    params = model.init(jax.random.key(0))
+    prompt = jnp.asarray(rng.integers(0, 40, (2, 6)))
+    full = model.generate(params, prompt, 8)
+
+    model, qparams = nn.quantize_linear_weights(model, params)
+    # Sequential-held MLP linears swapped too (paths like block0.mlp.0)
+    assert isinstance(model.block0.mlp[0], nn.QuantLinear)
+    assert isinstance(model.head, nn.QuantLinear)
+    out = model.generate(qparams, prompt, 8)
+    assert out.shape == full.shape
+    np.testing.assert_array_equal(np.asarray(out[:, :6]),
+                                  np.asarray(prompt))
+
+
+def test_weight_tied_linear_stays_tied(rng):
+    """A Linear registered under two attributes (weight tying) must stay
+    ONE module after conversion — both paths resolve to the same
+    QuantLinear and the single shared params leaf."""
+    class Tied(nn.Module):
+        def __init__(self):
+            super().__init__()
+            shared = nn.Linear(8, 8)
+            self.fc = shared
+            self.out = shared
+
+        def forward(self, x):
+            return self.out(self.fc(x))
+
+    net = Tied()
+    params = net.init(jax.random.key(0))
+    x = jnp.asarray(rng.standard_normal((3, 8)).astype(np.float32))
+    want = np.asarray(net.apply(params, x))
+    net, qp = nn.quantize_linear_weights(net, params)
+    assert isinstance(net.fc, nn.QuantLinear)
+    assert net.fc is net.out           # the tie survives
+    got = np.asarray(net.apply(qp, x))  # no KeyError for path 'out'
+    denom = max(np.abs(want).max(), 1e-6)
+    assert np.abs(got - want).max() / denom < 0.05
