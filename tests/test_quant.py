@@ -141,3 +141,29 @@ def test_weight_tied_linear_stays_tied(rng):
     got = np.asarray(net.apply(qp, x))  # no KeyError for path 'out'
     denom = max(np.abs(want).max(), 1e-6)
     assert np.abs(got - want).max() / denom < 0.05
+
+
+def test_attention_quantization(rng):
+    """attention=True also swaps MHSA for the int8 subclass; logits track
+    full precision and the KV-cache decode path still works."""
+    model = TransformerLM(vocab_size=40, dim=64, depth=2, num_heads=4,
+                          max_seq_len=32)
+    params = model.init(jax.random.key(2))
+    x = jnp.asarray(rng.integers(0, 40, (2, 12)))
+    want = np.asarray(model.apply(params, x))
+
+    model, qp = nn.quantize_linear_weights(model, params, attention=True)
+    assert isinstance(model.block0.attn, nn.QuantMultiheadSelfAttention)
+    assert qp["block0.attn"]["qkv_q"].dtype == jnp.int8
+    assert "qkv_weight" not in qp["block0.attn"]
+    got = np.asarray(model.apply(qp, x))
+    denom = max(np.abs(want).max(), 1e-6)
+    assert np.abs(got - want).max() / denom < 0.05
+
+    prompt = jnp.asarray(rng.integers(0, 40, (1, 5)))
+    out = model.generate(qp, prompt, 6)      # cached decode path
+    assert out.shape == (1, 11)
+
+    # idempotent: converting again is a no-op for already-quantized paths
+    model2, qp2 = nn.quantize_linear_weights(model, qp, attention=True)
+    assert qp2["block0.attn"] is qp["block0.attn"]

@@ -168,12 +168,19 @@ class MultiheadSelfAttention(Module):
             p["out_bias"] = jnp.zeros((self.embed_dim,))
         return p
 
+    def _proj_weights(self, p, dtype):
+        """The qkv/out projection weights — overridden by the int8
+        inference subclass (nn.quant.QuantMultiheadSelfAttention) to
+        dequantize on the fly."""
+        return p["qkv_weight"], p["out_weight"]
+
     def forward(self, x):
         from .module import _ctx
         ctx = _ctx()
         p = ctx.get_params(self._path)
         b, t, _ = x.shape
-        qkv = F.linear(x, p["qkv_weight"], p.get("qkv_bias"))
+        qkv_w, out_w = self._proj_weights(p, x.dtype)
+        qkv = F.linear(x, qkv_w, p.get("qkv_bias"))
         qkv = qkv.reshape(b, t, 3, self.num_heads, self.head_dim)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         if self.rope:
@@ -207,7 +214,7 @@ class MultiheadSelfAttention(Module):
             out = scaled_dot_product_attention(q, k, v, causal=self.causal,
                                                impl=self.attn_impl)
         out = out.reshape(b, t, self.embed_dim)
-        return F.linear(out, p["out_weight"], p.get("out_bias"))
+        return F.linear(out, out_w, p.get("out_bias"))
 
     def _decode(self, ctx, q, k, v):
         """Cached attention step.  q/k/v: (B, t, H, D) with t the number of

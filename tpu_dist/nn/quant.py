@@ -29,9 +29,11 @@ import numpy as np
 
 from .module import Module, _ctx
 from .layers import Linear
+from .attention import MultiheadSelfAttention
 from . import functional as F
 
-__all__ = ["QuantLinear", "quantize_linear_weights"]
+__all__ = ["QuantLinear", "QuantMultiheadSelfAttention",
+           "quantize_linear_weights"]
 
 
 class QuantLinear(Module):
@@ -68,6 +70,35 @@ class QuantLinear(Module):
                 f"out={self.out_features}, int8)")
 
 
+class QuantMultiheadSelfAttention(MultiheadSelfAttention):
+    """Inference-only MHSA with int8 qkv/out projection weights.
+
+    Same forward as :class:`~tpu_dist.nn.MultiheadSelfAttention` — only
+    the projection-weight fetch differs (dequant fused into the matmul).
+    Params: ``qkv_q``/``qkv_scale``, ``out_q``/``out_scale`` (+ biases).
+    Built by :func:`quantize_linear_weights` with ``attention=True``.
+    """
+
+    def create_params(self, key):
+        d = self.embed_dim
+        p = {"qkv_q": jnp.zeros((d, 3 * d), jnp.int8),
+             "qkv_scale": jnp.ones((3 * d,), jnp.float32),
+             "out_q": jnp.zeros((d, d), jnp.int8),
+             "out_scale": jnp.ones((d,), jnp.float32)}
+        if self.bias:
+            p["qkv_bias"] = jnp.zeros((3 * d,))
+            p["out_bias"] = jnp.zeros((d,))
+        return p
+
+    def _proj_weights(self, p, dtype):
+        return (p["qkv_q"].astype(dtype) * p["qkv_scale"].astype(dtype),
+                p["out_q"].astype(dtype) * p["out_scale"].astype(dtype))
+
+    def __repr__(self):
+        return (f"QuantMultiheadSelfAttention({self.embed_dim}, "
+                f"heads={self.num_heads}, int8)")
+
+
 def _quantize_weight(w) -> Tuple[np.ndarray, np.ndarray]:
     """Symmetric per-output-channel int8: w (in, out) ≈ q * scale[out]."""
     w = np.asarray(w, np.float32)
@@ -79,37 +110,56 @@ def _quantize_weight(w) -> Tuple[np.ndarray, np.ndarray]:
 
 def quantize_linear_weights(model: Module, params: dict,
                             skip: Optional[Sequence[str]] = None,
+                            attention: bool = False,
                             ) -> Tuple[Module, dict]:
     """Swap every ``nn.Linear`` in ``model`` for :class:`QuantLinear` and
-    quantize its weights in ``params``.
+    quantize its weights in ``params``; with ``attention=True`` also swap
+    every ``nn.MultiheadSelfAttention`` for
+    :class:`QuantMultiheadSelfAttention` (int8 qkv/out projections).
 
     Mutates ``model`` in place (topology objects hold no arrays — the
     same contract as ``convert_sync_batchnorm``) and returns ``(model,
     new_params)``.  ``skip``: param paths to leave in full precision
-    (e.g. a numerically sensitive head).  Non-Linear leaves (embeddings,
-    norms, convs, attention qkv) are untouched — quantize the attention
-    projections by constructing the model with separate Linears, or
-    extend the table here.
+    (e.g. a numerically sensitive head).  Embeddings, norms, and convs
+    are untouched.
     """
     skip = set(skip or ())
     model._assign_paths()
-    # one QuantLinear per unique Linear OBJECT: weight-tied modules (the
-    # same Linear registered under several attributes) keep sharing one
+    # one quantized module per unique OBJECT: weight-tied modules (the
+    # same module registered under several attributes) keep sharing one
     # module — and therefore one params path — after conversion.
-    # "weight" in params[path] is the idempotency check (already-converted
-    # paths carry q_weight instead).  Path "" is the root module itself —
-    # it has no parent to swap it on; wrap a bare Linear in a container.
+    # "weight"/"qkv_weight" in params[path] is the idempotency check
+    # (already-converted paths carry q_* leaves instead).  Path "" is the
+    # root module itself — it has no parent to swap it on; wrap it.
     q_for: dict = {}
     new_params = dict(params)
     for path, mod in model.named_modules():
-        if (isinstance(mod, Linear) and path and path not in skip
-                and path in params and "weight" in params[path]):
+        if not path or path in skip or path not in params:
+            continue
+        if isinstance(mod, Linear) and "weight" in params[path]:
             q_for[id(mod)] = QuantLinear(mod.in_features, mod.out_features,
                                          bias=mod.use_bias)
             q, scale = _quantize_weight(params[path]["weight"])
             leaf = {"q_weight": jnp.asarray(q), "scale": jnp.asarray(scale)}
             if "bias" in params[path]:
                 leaf["bias"] = params[path]["bias"]
+            new_params[path] = leaf
+        elif (attention and isinstance(mod, MultiheadSelfAttention)
+              and "qkv_weight" in params[path]):
+            q_mod = QuantMultiheadSelfAttention(
+                mod.embed_dim, mod.num_heads, bias=mod.bias,
+                causal=mod.causal, sequence_axis=mod.sequence_axis,
+                mode=mod.mode, attn_impl=mod.attn_impl, rope=mod.rope,
+                rope_theta=mod.rope_theta)
+            q_for[id(mod)] = q_mod
+            leaf = {}
+            for src, dst in (("qkv_weight", "qkv"), ("out_weight", "out")):
+                q, scale = _quantize_weight(params[path][src])
+                leaf[f"{dst}_q"] = jnp.asarray(q)
+                leaf[f"{dst}_scale"] = jnp.asarray(scale)
+            for b in ("qkv_bias", "out_bias"):
+                if b in params[path]:
+                    leaf[b] = params[path][b]
             new_params[path] = leaf
     # swap EVERY registration of each converted object (ties included)
     for _, parent in model.named_modules():
