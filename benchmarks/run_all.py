@@ -58,7 +58,8 @@ def _better(new: dict, old: dict) -> dict:
         # side-measurements recorded once (e.g. the decode row's
         # batch-scaling sweep) survive a ratchet replacement that did not
         # re-measure them
-        for extra_key in ("throughput_scaling",):
+        for extra_key in ("throughput_scaling", "reference_batch_recording",
+                          "linear_only_recording", "remat_on_recording"):
             if extra_key not in best:
                 loser = old if best is new else new
                 if extra_key in loser:
