@@ -91,6 +91,11 @@ def main():
     p.add_argument("--gen-temperature", default=0.0, type=float)
     p.add_argument("--gen-top-k", default=0, type=int)
     p.add_argument("--gen-top-p", default=1.0, type=float)
+    p.add_argument("--gen-int8", action="store_true",
+                   help="quantize matmul weights to int8 before generating "
+                        "(nn.quantize_linear_weights, attention included) — "
+                        "the serving recipe; the permutation check still "
+                        "has to pass on the quantized model")
     args = p.parse_args()
 
     if args.backend == "cpu":
@@ -151,9 +156,14 @@ def main():
             # the trained map is y[t] = perm[x[t]], so greedy decoding
             # iterates the permutation: each new token should be
             # perm[previous] — a self-checking generation demo
+            gen_params = state.params
+            if args.gen_int8:
+                model, gen_params = nn.quantize_linear_weights(
+                    model, jax.device_get(state.params), attention=True)
+                print("generating with int8 matmul weights")
             prompt = jnp.asarray(rng.integers(0, args.vocab, (1, 4)))
             out = model.generate(
-                state.params, prompt, args.generate,
+                gen_params, prompt, args.generate,
                 temperature=args.gen_temperature,
                 rng=(jax.random.key(1) if args.gen_temperature > 0
                      else None),
