@@ -204,3 +204,78 @@ def test_bf16_checkpoint_loads(rng):
         np.asarray(params["conv1"]["weight"]).ravel(),
         tnet.conv1.weight.detach().float().numpy().transpose(2, 3, 1, 0)
         .ravel(), atol=0)
+
+
+class TorchViTBlock(torch.nn.Module):
+    """torchvision EncoderBlock twin (ln_1/self_attention/ln_2/mlp with
+    Linears at mlp.0 and mlp.3) — state_dict keys match torchvision."""
+
+    def __init__(self, d, heads):
+        super().__init__()
+        self.ln_1 = torch.nn.LayerNorm(d, eps=1e-6)
+        self.self_attention = torch.nn.MultiheadAttention(d, heads,
+                                                          batch_first=True)
+        self.ln_2 = torch.nn.LayerNorm(d, eps=1e-6)
+        self.mlp = torch.nn.Sequential(
+            torch.nn.Linear(d, 4 * d), torch.nn.GELU(), torch.nn.Dropout(0),
+            torch.nn.Linear(4 * d, d), torch.nn.Dropout(0))
+
+    def forward(self, x):
+        h = self.ln_1(x)
+        a, _ = self.self_attention(h, h, h, need_weights=False)
+        x = x + a
+        return x + self.mlp(self.ln_2(x))
+
+
+class TorchViT(torch.nn.Module):
+    """Minimal torchvision VisionTransformer twin with its exact
+    state_dict naming (class_token, conv_proj, encoder.pos_embedding,
+    encoder.layers.encoder_layer_i.*, encoder.ln, heads.head)."""
+
+    def __init__(self, image_size=32, patch=8, layers=2, heads=4, d=64,
+                 classes=10):
+        super().__init__()
+        n = (image_size // patch) ** 2
+        self.class_token = torch.nn.Parameter(torch.zeros(1, 1, d))
+        self.conv_proj = torch.nn.Conv2d(3, d, patch, stride=patch)
+        enc = torch.nn.Module()
+        enc.pos_embedding = torch.nn.Parameter(
+            torch.empty(1, n + 1, d).normal_(std=0.02))
+        enc.layers = torch.nn.Module()
+        for i in range(layers):
+            setattr(enc.layers, f"encoder_layer_{i}",
+                    TorchViTBlock(d, heads))
+        enc.ln = torch.nn.LayerNorm(d, eps=1e-6)
+        self.encoder = enc
+        self.heads = torch.nn.Module()
+        self.heads.head = torch.nn.Linear(d, classes)
+        self.n_layers = layers
+
+    def forward(self, x):
+        b = x.shape[0]
+        x = self.conv_proj(x).flatten(2).transpose(1, 2)   # (B, N, d)
+        x = torch.cat([self.class_token.expand(b, -1, -1), x], dim=1)
+        x = x + self.encoder.pos_embedding
+        for i in range(self.n_layers):
+            x = getattr(self.encoder.layers, f"encoder_layer_{i}")(x)
+        x = self.encoder.ln(x)
+        return self.heads.head(x[:, 0])
+
+
+def test_vit_torchvision_weights_forward_parity(rng):
+    """Numeric oracle for the ViT key map: a torch ViT with torchvision's
+    exact state_dict naming loads into our VisionTransformer and produces
+    the same logits (NHWC vs NCHW included)."""
+    tnet = TorchViT()
+    ours = VisionTransformer(image_size=32, patch_size=8, num_layers=2,
+                             num_heads=4, hidden_dim=64, num_classes=10)
+    key_map = interop.vit_torchvision_key_map(num_layers=2)
+    params, state = interop.load_torch_state_dict(
+        ours, tnet.state_dict(), key_map=key_map)
+    assert state == {}
+
+    x = rng.standard_normal((2, 32, 32, 3)).astype(np.float32)
+    with torch.no_grad():
+        want = tnet(torch.tensor(np.transpose(x, (0, 3, 1, 2)))).numpy()
+    got = np.asarray(ours.apply(params, jnp.asarray(x)))
+    np.testing.assert_allclose(got, want, atol=2e-4)
