@@ -101,7 +101,7 @@ class TestNewGroup:
     def test_subgroup_collective(self):
         import jax
         import jax.numpy as jnp
-        from jax.experimental.shard_map import shard_map
+        from jax import shard_map
         from jax.sharding import PartitionSpec as P
         from tpu_dist import collectives as C
 

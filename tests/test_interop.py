@@ -184,6 +184,20 @@ def test_strict_reports_missing_and_unexpected(rng):
     assert params["fc"]["bias"].shape == (5,)
 
 
+def test_nonstrict_with_dtype_yields_uniform_tree():
+    """strict=False + dtype= must cast the MISSING (init-kept) leaves too —
+    a mixed f32/bf16 tree surprises jit donation and checkpoint round-trips."""
+    import jax.numpy as jnp
+    ours = OursBNNet()
+    tnet = TorchBNNet()
+    sd = dict(tnet.state_dict())
+    del sd["fc.bias"]
+    params, _ = interop.load_torch_state_dict(
+        ours, sd, strict=False, dtype=jnp.bfloat16)
+    dtypes = {leaves[k].dtype for leaves in params.values() for k in leaves}
+    assert dtypes == {jnp.dtype(jnp.bfloat16)}
+
+
 def test_shape_mismatch_is_loud():
     ours = OursBNNet()
     tnet = TorchBNNet()

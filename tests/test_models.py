@@ -168,6 +168,39 @@ class TestViT:
         # zero head -> zero logits at init, like torchvision
         np.testing.assert_array_equal(np.asarray(out), 0.0)
 
+    def test_conv_proj_and_mlp_init_match_torchvision(self):
+        """torchvision VisionTransformer init: conv_proj trunc_normal with
+        std sqrt(1/fan_in) + zero bias; MLPBlock xavier_uniform weights +
+        N(0, 1e-6) biases.  Checked distributionally on a big-enough tiny
+        model, plus cross-seed determinism (the fold-in must be stable)."""
+        m = self._tiny()
+        params = m.init(jax.random.key(0))
+        w = np.asarray(params["conv_proj"]["weight"])
+        std = (1.0 / (8 * 8 * 3)) ** 0.5
+        assert abs(w.std() - std) < 0.25 * std
+        assert abs(w.mean()) < 3 * std / (w.size ** 0.5) * 5
+        assert (np.asarray(params["conv_proj"]["bias"]) == 0).all()
+        fan_in, fan_out = params["block0.mlp.0"]["weight"].shape
+        limit = (6.0 / (fan_in + fan_out)) ** 0.5
+        mw = np.asarray(params["block0.mlp.0"]["weight"])
+        assert np.abs(mw).max() <= limit + 1e-7      # uniform support bound
+        assert mw.std() > 0.7 * limit / 3 ** 0.5     # not degenerate
+        mb = np.asarray(params["block0.mlp.2"]["bias"])
+        assert mb.std() < 1e-5 and mb.std() > 0      # N(0, 1e-6), not zeros
+        # attention: xavier-uniform in-proj, zero qkv/out biases
+        # (torch nn.MultiheadAttention._reset_parameters)
+        d, threed = params["block0.attn"]["qkv_weight"].shape
+        alim = (6.0 / (d + threed)) ** 0.5
+        qkv = np.asarray(params["block0.attn"]["qkv_weight"])
+        assert np.abs(qkv).max() <= alim + 1e-7
+        assert qkv.std() > 0.7 * alim / 3 ** 0.5
+        assert (np.asarray(params["block0.attn"]["qkv_bias"]) == 0).all()
+        assert (np.asarray(params["block0.attn"]["out_bias"]) == 0).all()
+        assert np.asarray(params["block0.attn"]["out_weight"]).std() > 0
+        again = m.init(jax.random.key(0))
+        np.testing.assert_array_equal(
+            w, np.asarray(again["conv_proj"]["weight"]))
+
     def test_trains_on_planted_signal(self):
         m = self._tiny(num_classes=2)
         params = m.init(jax.random.key(0))

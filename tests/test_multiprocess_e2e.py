@@ -120,6 +120,22 @@ _MB_WORKER = textwrap.dedent("""
     dist.monitored_barrier(timeout=60)
     out["barrier_ok"] = True
 
+    # 1b) repeated calls must GC older generations' store keys (per-epoch
+    # debugging must not leak): after passing barrier seq=3, every key of
+    # seq<=2 is gone (each rank deleted its own arrived key on entering the
+    # next call; rank 0 deleted /go once all arrivals at the next barrier
+    # proved it had no readers left).
+    for _ in range(3):
+        dist.monitored_barrier(timeout=60)
+    import tpu_dist.dist.process_group as _pgm
+    _store = _pgm._rdzv._store
+    leaked = [k for s in range(3)
+              for k in ([f"__monitored_barrier__/{s}/go"] +
+                        [f"__monitored_barrier__/{s}/arrived/{r}"
+                         for r in range(2)])
+              if _store.check(k)]
+    out["leaked"] = leaked
+
     # 2) rank 1 skips the second barrier: rank 0 must time out AND name it
     if rank == 0:
         try:
@@ -152,6 +168,7 @@ def test_monitored_barrier_two_processes(tmp_path):
     with open(tmp_path / "mb1.json") as f:
         res1 = json.load(f)
     assert res0["barrier_ok"] and res1["barrier_ok"]
+    assert res0["leaked"] == [] and res1["leaked"] == []
     assert "[1]" in res0["second"] and "did not reach" in res0["second"]
 
 

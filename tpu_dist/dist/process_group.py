@@ -323,6 +323,8 @@ def barrier(group: Optional[ProcessGroup] = None) -> None:
 
 _MB_SEQ = [0]  # per-process monitored_barrier call counter (all processes
                # must call it in the same order, like every collective)
+_MB_PASSED = [-1]  # last seq THIS process passed successfully — gates GC of
+                   # the previous generation's keys (below)
 
 
 def monitored_barrier(group: Optional[ProcessGroup] = None,
@@ -360,6 +362,19 @@ def monitored_barrier(group: Optional[ProcessGroup] = None,
     rank = get_rank()
     n = g.num_processes
     prefix = f"__monitored_barrier__/{seq}"
+    if seq > 0 and _MB_PASSED[0] == seq - 1:
+        # GC this rank's previous-generation arrival key so periodic calls
+        # (per-epoch debugging) don't grow the store without bound.  Safe
+        # only because this rank PASSED seq-1 (rank 0 finished reading
+        # arrived/* before publishing the /go we saw) — a rank that timed
+        # out on seq-1 and retried must NOT delete: rank 0 may still be
+        # polling seq-1 and would falsely name this rank missing (that
+        # error path leaks one key, which is fine).  The seq-1 /go key
+        # itself must not be deleted yet either — a straggler may still be
+        # waiting on it (rank 0 returns the moment it sets /go); it is
+        # GC'd below once rank 0 has seen every rank arrive at THIS
+        # barrier, which proves all left the previous one.
+        store.delete_key(f"__monitored_barrier__/{seq - 1}/arrived/{rank}")
     store.set(f"{prefix}/arrived/{rank}", b"1")
     import time as _time
     deadline = _time.monotonic() + timeout
@@ -375,7 +390,12 @@ def monitored_barrier(group: Optional[ProcessGroup] = None,
             raise RuntimeError(
                 f"monitored_barrier timed out after {timeout}s; process "
                 f"rank(s) {missing} did not reach the barrier")
+        if seq > 0:
+            # Everyone arrived here, so everyone left barrier seq-1: its
+            # release key has no remaining readers and can be GC'd.
+            store.delete_key(f"__monitored_barrier__/{seq - 1}/go")
         store.set(f"{prefix}/go", b"1")
+        _MB_PASSED[0] = seq
     else:
         try:
             store.wait([f"{prefix}/go"],
@@ -384,6 +404,7 @@ def monitored_barrier(group: Optional[ProcessGroup] = None,
             raise RuntimeError(
                 f"monitored_barrier timed out after {timeout}s waiting "
                 f"for process 0's release") from None
+        _MB_PASSED[0] = seq
 
 
 def abort(exit_code: int = 1, reason: str = "") -> None:

@@ -208,6 +208,15 @@ def load_torch_state_dict(model, state_dict, key_map: Optional[KeyMap] = None,
     fill(params, {}, is_state=False)
     fill(state, _STATE_LEAF_TO_TORCH, is_state=True)
 
+    if dtype is not None:
+        # Uniform-dtype guarantee: with strict=False, leaves missing from the
+        # state_dict kept their f32 seeded-init values — cast them too, so the
+        # returned params tree never mixes dtypes (mixed trees surprise jit
+        # donation and checkpoint round-trips).  No-op for leaves fill() cast.
+        for leaves in params.values():
+            for leaf in leaves:
+                leaves[leaf] = jnp.asarray(leaves[leaf], dtype)
+
     unexpected = [k for k in sd
                   if not k.endswith(_IGNORED_SUFFIXES)]
     if strict and (missing or unexpected):

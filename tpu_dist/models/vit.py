@@ -19,13 +19,21 @@ Parity points (torchvision ``VisionTransformer``):
   embeddings over ``num_patches + 1`` positions, encoder LayerNorm eps
   1e-6, final LayerNorm before the head;
 - init follows torchvision: zeros class token, N(0, 0.02) position
-  embeddings, zero-initialized head.
+  embeddings, zero-initialized head, ``trunc_normal(std=sqrt(1/fan_in))``
+  patch-projection conv, xavier-uniform MLP weights with ``N(0, 1e-6)``
+  biases (torchvision's ``MLPBlock`` init), and xavier-uniform attention
+  in-proj with zero attention biases (``nn.MultiheadAttention`` reset) —
+  so from-scratch training starts from the same distributions.  (The
+  attention out-proj weight and LayerNorms use torch's defaults, which
+  are also ours.)
 
 Layout is NHWC throughout (TPU-native; torchvision is NCHW) — images are
 ``(B, H, W, 3)`` like every other model here.
 """
 
 from __future__ import annotations
+
+import math
 
 import jax
 import jax.numpy as jnp
@@ -35,6 +43,13 @@ from .transformer import TransformerBlock
 
 __all__ = ["VisionTransformer", "vit_b_16", "vit_b_32", "vit_l_16",
            "vit_l_32"]
+
+
+def _stable_fold(name: str) -> int:
+    """Deterministic string→int for ``jax.random.fold_in`` (``hash()`` is
+    PYTHONHASHSEED-salted, which would make init differ across processes)."""
+    import zlib
+    return zlib.crc32(name.encode()) & 0x7FFFFFFF
 
 
 class _TokenEmbeddings(nn.Module):
@@ -110,10 +125,46 @@ class VisionTransformer(nn.Module):
         return self.head(x[:, 0])                  # class token only
 
     def init(self, key):
+        from ..nn import init as I
         params = super().init(key)
+
+        def k(name):
+            return jax.random.fold_in(key, _stable_fold(name))
+
         # torchvision zero-initializes the classification head
         params["head"]["weight"] = jnp.zeros_like(params["head"]["weight"])
         params["head"]["bias"] = jnp.zeros_like(params["head"]["bias"])
+        # conv_proj: trunc_normal(std=sqrt(1/fan_in)), zero bias
+        # (torchvision VisionTransformer.__init__; fan_in = 3*p*p)
+        w = params["conv_proj"]["weight"]
+        params["conv_proj"]["weight"] = I.trunc_normal(
+            k("conv_proj"), w.shape,
+            std=math.sqrt(1.0 / (w.shape[0] * w.shape[1] * w.shape[2])),
+            dtype=w.dtype)
+        params["conv_proj"]["bias"] = jnp.zeros_like(
+            params["conv_proj"]["bias"])
+        for path, leaves in params.items():
+            # encoder MLP Linears: xavier_uniform weight, N(0, 1e-6) bias
+            # (torchvision MLPBlock init loop).  Weights here are (in, out).
+            if ".mlp." in path:
+                leaves["weight"] = I.xavier_uniform(
+                    k(path + "/w"), leaves["weight"].shape,
+                    dtype=leaves["weight"].dtype)
+                leaves["bias"] = 1e-6 * jax.random.normal(
+                    k(path + "/b"), leaves["bias"].shape,
+                    leaves["bias"].dtype)
+            # encoder attention: torch nn.MultiheadAttention._reset_parameters
+            # — xavier_uniform in_proj weight, zero in_proj and out_proj
+            # biases.  (out_proj WEIGHT keeps torch's Linear default, which
+            # is also our Linear default.)  xavier's limit is symmetric in
+            # fan_in+fan_out, so our (d, 3d) qkv layout gives the same bound
+            # as torch's (3d, d) in_proj_weight.
+            elif path.endswith(".attn"):
+                leaves["qkv_weight"] = I.xavier_uniform(
+                    k(path + "/qkv"), leaves["qkv_weight"].shape,
+                    dtype=leaves["qkv_weight"].dtype)
+                leaves["qkv_bias"] = jnp.zeros_like(leaves["qkv_bias"])
+                leaves["out_bias"] = jnp.zeros_like(leaves["out_bias"])
         return params
 
 

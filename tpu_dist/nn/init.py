@@ -21,6 +21,7 @@ import jax.numpy as jnp
 __all__ = [
     "calculate_fan", "uniform", "normal", "zeros", "ones",
     "kaiming_uniform", "kaiming_normal", "torch_default_uniform",
+    "xavier_uniform", "trunc_normal",
 ]
 
 
@@ -80,3 +81,24 @@ def torch_default_uniform(key, shape, fan_in: int, dtype=jnp.float32):
     """torch's default Conv/Linear weight+bias init: U(-1/sqrt(fan_in), +)."""
     bound = 1.0 / math.sqrt(fan_in) if fan_in > 0 else 0.0
     return uniform(key, shape, -bound, bound, dtype)
+
+
+def xavier_uniform(key, shape, gain: float = 1.0, dtype=jnp.float32):
+    """torch ``nn.init.xavier_uniform_``: U(±gain*sqrt(6/(fan_in+fan_out)))."""
+    fan_in, fan_out = calculate_fan(shape)
+    limit = gain * math.sqrt(6.0 / (fan_in + fan_out))
+    return uniform(key, shape, -limit, limit, dtype)
+
+
+def trunc_normal(key, shape, std: float = 1.0, mean: float = 0.0,
+                 a: float = -2.0, b: float = 2.0, dtype=jnp.float32):
+    """torch ``nn.init.trunc_normal_``: N(mean, std) truncated to [a, b].
+
+    NOTE torch's ``a``/``b`` are in VALUE units, not standard deviations —
+    the defaults ±2 are effectively untruncated for the small stds
+    torchvision passes (e.g. sqrt(1/768)); we reproduce that exactly by
+    rescaling the bounds into standard units for jax's sampler.
+    """
+    lo = (a - mean) / std
+    hi = (b - mean) / std
+    return mean + std * jax.random.truncated_normal(key, lo, hi, shape, dtype)
