@@ -200,6 +200,55 @@ def run_cifar(epochs: int = 5, global_batch: int = 256) -> dict:
     return out
 
 
+def run_torch_parity(steps: int = 200, lr: float = 0.05) -> dict:
+    """The DIRECT oracle: train torch's literal ConvNet and ours on
+    identical batches/recipe (init shared via interop) and record the paired
+    loss curves + final accuracies.  Runs on CPU with f32 highest-precision
+    matmuls — torch has no TPU backend, and the comparison is about MATH
+    parity, not speed.  The assertions live in
+    tests/test_torch_e2e_parity.py; this records the evidence."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    sys.path.insert(0, _REPO)
+    from tests.test_torch_e2e_parity import run_curves
+
+    B = 100
+    t, j, ta, ja = run_curves(lr, steps, B)
+    d = np.abs(t - j)
+    stride = max(1, steps // 20)
+    return {
+        "recipe": f"torch ConvNet vs tpu_dist ConvNet, identical init "
+                  f"(interop) + batches, SGD lr={lr:g} batch {B}, "
+                  f"{steps} steps, cpu f32 highest-precision",
+        "oracle": "tests/test_torch_e2e_parity.py (asserted there; "
+                  "recorded here)",
+        "max_step_loss_delta": float(d.max()),
+        "mean_step_loss_delta": float(d.mean()),
+        "final_loss_torch": float(t[-1]),
+        "final_loss_tpu_dist": float(j[-1]),
+        "final_eval_accuracy_torch": ta,
+        "final_eval_accuracy_tpu_dist": ja,
+        "curve_torch_every%d" % stride: [round(v, 5) for v in t[::stride]],
+        "curve_tpu_dist_every%d" % stride: [round(v, 5) for v in j[::stride]],
+    }
+
+
+def _merge_write(rows: dict) -> str:
+    """Merge ``rows`` into ACCURACY.json, reading the file AT WRITE TIME so
+    rows recorded by other modes/invocations while this run was training
+    (the snapshot-at-start trap that bit BENCH_EXTENDED.json twice) survive."""
+    out = os.path.join(_REPO, "ACCURACY.json")
+    results = {}
+    if os.path.exists(out):
+        with open(out) as f:
+            results = json.load(f)
+    results.update(rows)
+    with open(out, "w") as f:
+        json.dump(results, f, indent=1)
+    return out
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
@@ -207,33 +256,37 @@ def main() -> None:
                          "recording)")
     ap.add_argument("--mnist-epochs", type=int, default=2)
     ap.add_argument("--cifar-epochs", type=int, default=5)
+    ap.add_argument("--torch-parity-only", action="store_true",
+                    help="run only the torch-vs-tpu_dist curve comparison "
+                         "and merge its row into the existing ACCURACY.json")
     args = ap.parse_args()
+    if args.torch_parity_only:
+        row = run_torch_parity()
+        out = _merge_write({"torch_e2e_curve_parity": row})
+        print(f"merged torch_e2e_curve_parity into {out}")
+        return
     if args.quick:
         args.mnist_epochs = args.cifar_epochs = 1
 
     import jax
-    platform = jax.devices()[0].platform
-    results = {"platform": platform,
-               "device": str(jax.devices()[0]),
-               # ref-exact hyperparams: slow monotone decline, like the
-               # reference's own screenshot
-               "mnist_convnet_ref_recipe": run_mnist(epochs=args.mnist_epochs),
-               # same model/pipeline, workable lr: accuracy convergence
-               # lr 0.01+momentum: converges; 0.05 diverges at batch 100
-               # (recorded epoch-1 loss 20.6 -> uniform collapse)
-               "mnist_convnet_tuned": run_mnist(
-                   epochs=max(1, args.mnist_epochs // 2), lr=0.01,
-                   momentum=0.9),
-               "cifar10_resnet18_bf16": run_cifar(epochs=args.cifar_epochs)}
+    rows = {"platform": jax.devices()[0].platform,
+            "device": str(jax.devices()[0]),
+            # ref-exact hyperparams: slow monotone decline, like the
+            # reference's own screenshot
+            "mnist_convnet_ref_recipe": run_mnist(epochs=args.mnist_epochs),
+            # same model/pipeline, workable lr: accuracy convergence
+            # lr 0.01+momentum: converges; 0.05 diverges at batch 100
+            # (recorded epoch-1 loss 20.6 -> uniform collapse)
+            "mnist_convnet_tuned": run_mnist(
+                epochs=max(1, args.mnist_epochs // 2), lr=0.01,
+                momentum=0.9),
+            "cifar10_resnet18_bf16": run_cifar(epochs=args.cifar_epochs)}
 
-    out = os.path.join(_REPO, "ACCURACY.json")
-    if args.quick and os.path.exists(out):
+    if args.quick and os.path.exists(os.path.join(_REPO, "ACCURACY.json")):
         print("quick mode: not overwriting existing ACCURACY.json")
-        print(json.dumps(results, indent=1))
+        print(json.dumps(rows, indent=1))
         return
-    with open(out, "w") as f:
-        json.dump(results, f, indent=1)
-    print(f"wrote {out}")
+    print(f"wrote {_merge_write(rows)}")
 
 
 if __name__ == "__main__":

@@ -203,7 +203,13 @@ def load_torch_state_dict(model, state_dict, key_map: Optional[KeyMap] = None,
                         f"map to {_join(path, leaf)} {want}")
                 cast = leaves[leaf].dtype if (is_state or dtype is None) \
                     else dtype
-                leaves[leaf] = jnp.asarray(a, cast)
+                # jnp.array, NOT asarray: `a` may be a zero-copy VIEW of the
+                # torch tensor's storage (_np does .numpy()), and jax's CPU
+                # backend zero-copies aligned same-dtype numpy arrays — an
+                # asarray here aliases live torch parameters, so a later
+                # in-place torch `optimizer.step()` would silently mutate
+                # this "immutable" tree (caught by the e2e parity test).
+                leaves[leaf] = jnp.array(a, cast)
 
     fill(params, {}, is_state=False)
     fill(state, _STATE_LEAF_TO_TORCH, is_state=True)
@@ -244,15 +250,20 @@ def to_torch_state_dict(model, params, model_state=None,
             key = _map_key(_torch_key(path, leaf, kind), key_map)
             ours_key = _join(path, leaf)
             if ours_key in transforms:
-                out[key] = transforms[ours_key](_np(a))
+                t = transforms[ours_key](_np(a))
             else:
-                out[key] = _to_torch(kind, leaf, _np(a))
+                t = _to_torch(kind, leaf, _np(a))
+            # copy=True: _np of a jax array is a zero-copy VIEW of the XLA
+            # buffer (so are no-transpose leaves like biases after _to_torch);
+            # handing that to torch.as_tensor + an in-place optimizer step
+            # would mutate the live jax array.  Mirror of the load-side copy.
+            out[key] = np.array(t)
     for path, leaves in (model_state or {}).items():
         for leaf, a in leaves.items():
             if leaf not in _STATE_LEAF_TO_TORCH:
                 continue  # no torch analogue (e.g. MoE aux_loss)
             out[_map_key(_join(path, _STATE_LEAF_TO_TORCH[leaf]),
-                         key_map)] = _np(a)
+                         key_map)] = np.array(_np(a))
     return out
 
 
