@@ -40,16 +40,14 @@ import sys
 import time
 
 
-def main():
-    import jax
+def run() -> dict:
+    """Measure and return the headline record (also used by
+    benchmarks/run_all.py to keep a best-ever copy of this metric in
+    BENCH_EXTENDED.json, which README §8b cites)."""
     import jax.numpy as jnp
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import tpu_dist.dist as dist
-    from tpu_dist import nn, optim
-    from tpu_dist.models import ConvNet
-    from tpu_dist.parallel import DistributedDataParallel
-    from jax.sharding import NamedSharding, PartitionSpec as P
 
     per_chip_batch = int(os.environ.get("BENCH_BATCH", 8192))
     steps = max(2, int(os.environ.get("BENCH_STEPS", 50)))
@@ -58,7 +56,25 @@ def main():
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
     compute_dtype = None if dtype == "float32" else jnp.dtype(dtype)
 
-    pg = dist.init_process_group()
+    own_group = not dist.is_initialized()
+    pg = dist.init_process_group() if own_group else dist.get_default_group()
+    try:
+        return _measure(pg, per_chip_batch, steps, warmup, reps, dtype,
+                        compute_dtype)
+    finally:
+        if own_group:
+            dist.destroy_process_group()
+
+
+def _measure(pg, per_chip_batch, steps, warmup, reps, dtype, compute_dtype):
+    import jax
+    import jax.numpy as jnp
+    import tpu_dist.dist as dist
+    from tpu_dist import nn, optim
+    from tpu_dist.models import ConvNet
+    from tpu_dist.parallel import DistributedDataParallel
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
     n_chips = dist.get_world_size()
     batch = per_chip_batch * n_chips
 
@@ -90,7 +106,7 @@ def main():
     xs_short = jax.block_until_ready(xs[:n_short])
     ys_short = ys[:n_short]
 
-    def run_chunk(cx, cy, k):
+    def run_chunk(cx, cy):
         # fresh state per rep: donated buffers cannot be reused
         state = ddp.init(seed=0)
         t0 = time.perf_counter()
@@ -99,11 +115,10 @@ def main():
         return time.perf_counter() - t0
 
     for _ in range(warmup):  # compile both shapes + warm
-        run_chunk(xs, ys, steps)
-        run_chunk(xs_short, ys_short, n_short)
-    best_long = min(run_chunk(xs, ys, steps) for _ in range(reps))
-    best_short = min(run_chunk(xs_short, ys_short, n_short)
-                     for _ in range(reps))
+        run_chunk(xs, ys)
+        run_chunk(xs_short, ys_short)
+    best_long = min(run_chunk(xs, ys) for _ in range(reps))
+    best_short = min(run_chunk(xs_short, ys_short) for _ in range(reps))
     step_time = (best_long - best_short) / (steps - n_short)
     images_per_sec_per_chip = batch / step_time / n_chips
 
@@ -119,14 +134,24 @@ def main():
         except (ValueError, KeyError):
             pass
 
-    print(json.dumps({
+    # Model-FLOPs accounting so run_all's physics gate (_plausible) can
+    # reject contention artifacts before they ratchet in as best-ever.
+    # fwd/image: conv1 2*26*26*32*25 + conv2 2*11*11*64*288 +
+    # conv3 2*8*8*128*576 + fc 2*2048*10 = 15,020,288; train ≈ 3x fwd.
+    train_flops_per_image = 3 * 15_020_288
+    return {
         "metric": "mnist_convnet_train_images_per_sec_per_chip",
         "value": round(images_per_sec_per_chip, 1),
         "unit": "images/sec/chip",
         "vs_baseline": round(vs, 3),
         "dtype": dtype,
-    }))
-    dist.destroy_process_group()
+        "achieved_model_tflops": round(
+            images_per_sec_per_chip * train_flops_per_image / 1e12, 2),
+    }
+
+
+def main():
+    print(json.dumps(run()))
 
 
 if __name__ == "__main__":

@@ -136,6 +136,7 @@ def main() -> None:
             pass
 
     metric_names = {
+        "mnist": "mnist_convnet_train_images_per_sec_per_chip",
         "resnet_cifar": "resnet18_cifar10_bf16_train_images_per_sec_per_chip",
         "scaling": "ddp_weak_scaling_overhead_virtual_cpu_mesh",
         "input_pipeline": "imagenet_input_pipeline_vs_resnet50_step",
@@ -151,8 +152,10 @@ def main() -> None:
         "gen_latency": "transformer_lm_decode_batch1_tokens_per_sec",
         "gen_latency_int8": "transformer_lm_decode_batch1_int8_tokens_per_sec",
     }
+    import bench  # repo-root headline (MNIST ConvNet) — ratchet a copy here
     results = []
-    for name, fn in (("resnet_cifar", resnet_cifar.run),
+    for name, fn in (("mnist", bench.run),
+                     ("resnet_cifar", resnet_cifar.run),
                      ("scaling", scaling.run),
                      ("input_pipeline", input_pipeline.run),
                      ("attention", attention.run),
