@@ -234,6 +234,79 @@ def run_torch_parity(steps: int = 200, lr: float = 0.05) -> dict:
     }
 
 
+def run_noisy_oracle(epochs: int = 4, n_train: int = 20000,
+                     label_noise: float = 0.25) -> dict:
+    """The LOW-SNR oracle row: train the ConvNet pipeline on
+    ``synthetic_mnist_noisy_arrays`` (uniform label flips, probability
+    ``label_noise``) and record final accuracy against the EXACT analytic
+    ceiling ``(1 - rho) + rho/10``.  Two-sided: a correct pipeline lands in
+    ceiling ± 3 binomial SEs; a subtly broken one undershoots, and nothing
+    can overshoot (the flips are independent of the images).  Asserted in
+    tests/test_accuracy_oracle.py; recorded here."""
+    import tpu_dist.dist as dist
+    from tpu_dist import nn, optim
+    from tpu_dist.data import (ArrayImageDataset, DataLoader, DeviceLoader,
+                               synthetic_mnist_noisy_arrays, transforms)
+    from tpu_dist.models import ConvNet
+    from tpu_dist.parallel import DistributedDataParallel
+
+    norm = transforms.Normalize(transforms.MNIST_MEAN, transforms.MNIST_STD)
+    xtr, ytr = synthetic_mnist_noisy_arrays(True, n_train,
+                                            label_noise=label_noise)
+    xte, yte = synthetic_mnist_noisy_arrays(False, 10000,
+                                            label_noise=label_noise)
+    train_ds = ArrayImageDataset(xtr, ytr, transform=norm)
+    test_ds = ArrayImageDataset(xte, yte, transform=norm)
+
+    own = not dist.is_initialized()
+    pg = dist.init_process_group() if own else dist.get_default_group()
+    try:
+        # per-replica batch 100, like run_mnist: the global batch scales
+        # with world size so it always divides the device count (on the
+        # 1-chip recording world=1 and this is exactly batch 100)
+        world = dist.get_world_size()
+        ddp = DistributedDataParallel(
+            ConvNet(), optimizer=optim.SGD(lr=0.01, momentum=0.9),
+            loss_fn=nn.CrossEntropyLoss(), group=pg)
+        state = ddp.init(seed=0)
+        loader = DeviceLoader(DataLoader(train_ds, batch_size=100 * world,
+                                         drop_last=True, shuffle=True,
+                                         seed=0), group=pg)
+        test_loader = DeviceLoader(DataLoader(test_ds,
+                                              batch_size=1000 * world,
+                                              drop_last=False), group=pg,
+                                   local_shards=False)
+        t0 = time.perf_counter()
+        accs = []
+        for ep in range(epochs):
+            loader.set_epoch(ep)
+            state, mean_loss, _ = _epoch_pass(ddp, state, loader)
+            res = ddp.evaluate(state, test_loader)
+            accs.append(round(res["accuracy"], 4))
+            print(f"noisy-oracle epoch {ep + 1}/{epochs}: train loss "
+                  f"{mean_loss:.4f}, test acc {res['accuracy']:.4f}",
+                  flush=True)
+        ceiling = (1.0 - label_noise) + label_noise / 10.0
+        se3 = 3.0 * (ceiling * (1.0 - ceiling) / len(yte)) ** 0.5
+        return {
+            "recipe": f"mnist_convnet_sgd0.01_m0.9_batch100 on "
+                      f"synthetic_mnist_noisy_arrays(label_noise="
+                      f"{label_noise})",
+            "oracle": "tests/test_accuracy_oracle.py (asserted there)",
+            "label_noise": label_noise,
+            "analytic_ceiling": round(ceiling, 4),
+            "expected_band": [round(ceiling - se3, 4),
+                              round(ceiling + se3, 4)],
+            "test_accuracy_per_epoch": accs,
+            "final_test_accuracy": accs[-1],
+            "in_band": bool(ceiling - se3 <= accs[-1] <= ceiling + se3),
+            "wall_clock_sec": round(time.perf_counter() - t0, 1),
+        }
+    finally:
+        if own:
+            dist.destroy_process_group()
+
+
 def _merge_write(rows: dict) -> str:
     """Merge ``rows`` into ACCURACY.json, reading the file AT WRITE TIME so
     rows recorded by other modes/invocations while this run was training
@@ -259,11 +332,19 @@ def main() -> None:
     ap.add_argument("--torch-parity-only", action="store_true",
                     help="run only the torch-vs-tpu_dist curve comparison "
                          "and merge its row into the existing ACCURACY.json")
+    ap.add_argument("--noisy-oracle-only", action="store_true",
+                    help="run only the low-SNR label-noise oracle and merge "
+                         "its row into the existing ACCURACY.json")
     args = ap.parse_args()
     if args.torch_parity_only:
         row = run_torch_parity()
         out = _merge_write({"torch_e2e_curve_parity": row})
         print(f"merged torch_e2e_curve_parity into {out}")
+        return
+    if args.noisy_oracle_only:
+        row = run_noisy_oracle()
+        out = _merge_write({"mnist_low_snr_oracle": row})
+        print(f"merged mnist_low_snr_oracle into {out}")
         return
     if args.quick:
         args.mnist_epochs = args.cifar_epochs = 1

@@ -1,11 +1,12 @@
-"""Weak-scaling overhead estimate on a virtual 1..8-device CPU mesh.
+"""Weak-scaling overhead estimate on a virtual 1..32-device CPU mesh.
 
 Without pod hardware (the sandbox exposes ONE real chip), true ICI scaling
 efficiency (BASELINE.md north star: >=90% linear, 1->32 chips) cannot be
 measured.  What CAN be measured in-repo is the *framework + collective
 overhead* the compiled DDP step adds as the world grows: run the fused step
-at world sizes 1,2,4,8 on ``--xla_force_host_platform_device_count=8`` CPU
-devices with constant per-device batch.
+at world sizes 1,2,4,8,16,32 — the full north-star range — on
+``--xla_force_host_platform_device_count=32`` CPU devices with constant
+per-device batch.
 
 The host may have only ONE physical core, so the N virtual devices' compute
 serializes: ideal weak scaling here is ``t_N = N * t_1``, and we report
@@ -31,9 +32,12 @@ import sys
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+DEFAULT_WORLD_SIZES = (1, 2, 4, 8, 16, 32)  # BASELINE.md north star: 1->32
+
+
 def _measure(per_device_batch: int = 128, steps: int = 30,
-             reps: int = 3) -> dict:
-    """Run inside a process whose backend is 8 CPU devices."""
+             reps: int = 3, world_sizes=DEFAULT_WORLD_SIZES) -> dict:
+    """Run inside a process whose backend has >= max(world_sizes) devices."""
     import jax
     import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -47,7 +51,7 @@ def _measure(per_device_batch: int = 128, steps: int = 30,
     dist.init_process_group(backend="cpu")
     rng = np.random.default_rng(0)
     times = {}
-    for n in (1, 2, 4, 8):
+    for n in world_sizes:
         pg = dist.new_group(ranks=range(n))
         ddp = DistributedDataParallel(
             ConvNet(), optimizer=optim.SGD(lr=1e-4),
@@ -59,7 +63,11 @@ def _measure(per_device_batch: int = 128, steps: int = 30,
         y = jax.device_put(rng.integers(0, 10, batch).astype(np.int32),
                            sharding)
 
-        times[n] = ddp_repeat_step_time(ddp, x, y, steps=steps, reps=reps)
+        # big worlds serialize N× the compute on the 1-core host — scale
+        # the scanned-step count down so wall clock stays bounded without
+        # touching the per-step quantity being measured
+        n_steps = max(4, steps // max(1, n // 8))
+        times[n] = ddp_repeat_step_time(ddp, x, y, steps=n_steps, reps=reps)
     dist.destroy_process_group()
 
     t1 = times[1]
@@ -73,14 +81,19 @@ def _measure(per_device_batch: int = 128, steps: int = 30,
     }
 
 
-def run(per_device_batch: int = 128, steps: int = 30, reps: int = 3) -> dict:
-    """Re-exec on a forced 8-device CPU backend and return the measurement."""
+def run(per_device_batch: int = 128, steps: int = 30, reps: int = 3,
+        world_sizes=DEFAULT_WORLD_SIZES) -> dict:
+    """Re-exec on a forced max(world_sizes)-device CPU backend and return
+    the measurement."""
     code = (
-        "import os\n"
-        "_flag = '--xla_force_host_platform_device_count=8'\n"
-        "if _flag not in os.environ.get('XLA_FLAGS', ''):\n"
-        "    os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS', '')"
-        " + ' ' + _flag).strip()\n"
+        "import os, re\n"
+        f"_flag = '--xla_force_host_platform_device_count="
+        f"{max(world_sizes)}'\n"
+        # drop any inherited device-count flag (e.g. conftest's =8) so the
+        # requested count is the only one XLA sees
+        "_rest = re.sub(r'--xla_force_host_platform_device_count=\\d+', '',\n"
+        "               os.environ.get('XLA_FLAGS', ''))\n"
+        "os.environ['XLA_FLAGS'] = (_rest + ' ' + _flag).strip()\n"
         "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
         "import jax\n"
         "jax.config.update('jax_platforms', 'cpu')\n"
@@ -88,7 +101,7 @@ def run(per_device_batch: int = 128, steps: int = 30, reps: int = 3) -> dict:
         "import json\n"
         "from benchmarks.scaling import _measure\n"
         f"print('BENCH_JSON ' + json.dumps(_measure({per_device_batch}, "
-        f"{steps}, {reps})))\n"
+        f"{steps}, {reps}, {tuple(world_sizes)!r})))\n"
     )
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"

@@ -1,0 +1,86 @@
+"""Low-SNR accuracy oracle: the pipeline must hit an EXACT analytic band.
+
+The clean synthetic datasets saturate at ~0.9998 accuracy, which cannot
+distinguish a subtly broken pipeline (wrong shard arithmetic, BN semantics,
+augmentation leak) from a correct one.  ``synthetic_mnist_noisy_arrays``
+flips each label uniformly with probability rho=0.25, making the best
+achievable held-out accuracy exactly ``(1-rho) + rho/10 = 0.775`` — a
+TWO-SIDED oracle: a correct pipeline lands within ±3 binomial standard
+errors of the ceiling, a broken one visibly undershoots, and nothing can
+overshoot in expectation (the flips are independent of the images).
+
+The recorded chip run lives in ACCURACY.json (``mnist_low_snr_oracle``,
+written by benchmarks/accuracy_run.py --noisy-oracle-only); this test runs
+the same recipe end to end (sampler -> loader -> DDP fused step ->
+evaluate) on the CPU mesh and asserts the band.
+"""
+
+import numpy as np
+import pytest
+
+import tpu_dist.dist as dist
+from tpu_dist import nn, optim
+from tpu_dist.data import (ArrayImageDataset, DataLoader, DeviceLoader,
+                           synthetic_mnist_noisy_arrays, transforms)
+from tpu_dist.models import ConvNet
+from tpu_dist.parallel import DistributedDataParallel
+
+pytestmark = pytest.mark.slow
+
+RHO = 0.25
+CEILING = (1.0 - RHO) + RHO / 10.0          # 0.775, see module docstring
+
+
+def test_label_noise_rate_is_exact():
+    """The generator's flip rate must match rho*(1-1/C) (flips that land on
+    the true class are not observable), else the analytic ceiling is wrong."""
+    from tpu_dist.data import synthetic_mnist_arrays
+    x, y = synthetic_mnist_noisy_arrays(True, 40000)
+    xc, yc = synthetic_mnist_arrays(True, 40000)
+    np.testing.assert_array_equal(x, xc)     # images untouched
+    rate = float((y != yc).mean())
+    expect = RHO * (1 - 1 / 10)
+    assert abs(rate - expect) < 0.01, (rate, expect)
+    # train/test flips are independent draws
+    _, yt = synthetic_mnist_noisy_arrays(False, 10000)
+    _, ytc = synthetic_mnist_arrays(False, 10000)
+    assert 0.19 < float((yt != ytc).mean()) < 0.26
+
+
+def test_pipeline_hits_the_analytic_band():
+    if dist.is_initialized():
+        dist.destroy_process_group()
+    pg = dist.init_process_group()
+    try:
+        sub = dist.new_group(ranks=[0, 1, 2, 3])   # batch 100 -> 25/device
+        norm = transforms.Normalize(transforms.MNIST_MEAN,
+                                    transforms.MNIST_STD)
+        xtr, ytr = synthetic_mnist_noisy_arrays(True, 20000)
+        xte, yte = synthetic_mnist_noisy_arrays(False, 10000)
+        ddp = DistributedDataParallel(
+            ConvNet(), optimizer=optim.SGD(lr=0.01, momentum=0.9),
+            loss_fn=nn.CrossEntropyLoss(), group=sub)
+        state = ddp.init(seed=0)
+        loader = DeviceLoader(
+            DataLoader(ArrayImageDataset(xtr, ytr, transform=norm),
+                       batch_size=100, drop_last=True, shuffle=True, seed=0),
+            group=sub)
+        test_loader = DeviceLoader(
+            DataLoader(ArrayImageDataset(xte, yte, transform=norm),
+                       batch_size=1000, drop_last=False),
+            group=sub, local_shards=False)
+        # 2 epochs suffice: the recorded chip run (ACCURACY.json) is in
+        # band after epoch 1 and flat from epoch 2 on
+        for ep in range(2):
+            loader.set_epoch(ep)
+            for xb, yb in loader:
+                state, _ = ddp.train_step(state, xb, yb)
+        acc = ddp.evaluate(state, test_loader)["accuracy"]
+    finally:
+        dist.destroy_process_group()
+
+    se3 = 3.0 * (CEILING * (1.0 - CEILING) / len(yte)) ** 0.5   # ±0.0125
+    assert CEILING - se3 <= acc <= CEILING + se3, (
+        f"accuracy {acc:.4f} outside the analytic band "
+        f"[{CEILING - se3:.4f}, {CEILING + se3:.4f}] — the pipeline is "
+        "either broken (undershoot) or leaking labels (overshoot)")

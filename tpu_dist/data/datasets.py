@@ -33,6 +33,7 @@ __all__ = [
     "ImageFolder", "SyntheticImageNet",
     "Subset", "ConcatDataset", "random_split",
     "synthetic_mnist_arrays", "synthetic_cifar10_arrays",
+    "synthetic_mnist_noisy_arrays",
 ]
 
 
@@ -240,6 +241,38 @@ def synthetic_cifar10_arrays(train: bool, n: Optional[int] = None):
     if n is None:
         n = 50000 if train else 10000
     return _synthetic_arrays(n, (32, 32), 3, 10, (0xDA7A, 1), int(train))
+
+
+def synthetic_mnist_noisy_arrays(train: bool, n: Optional[int] = None,
+                                 label_noise: float = 0.25):
+    """The LOW-SNR accuracy oracle: MNIST-shaped data whose achievable test
+    accuracy has an EXACT, two-sided analytic ceiling.
+
+    Construction: the same deterministic class templates as
+    :func:`synthetic_mnist_arrays`, then each label is replaced with a
+    uniform draw over all ``C=10`` classes with probability
+    ``label_noise`` (train AND test, independent draws).  A model that
+    learns the true class mapping scores exactly
+
+        ceiling = (1 - label_noise) + label_noise / C          # = 0.775
+
+    on held-out noisy labels — and NOTHING can score higher in expectation,
+    because the flips are independent of the images.  So unlike the clean
+    synthetic set (which saturates at 0.9998 and cannot discriminate), a
+    correct pipeline lands in a narrow band around 0.775 (±~3 SE of the
+    10k-sample binomial ≈ ±0.013) while a subtly broken one (wrong shard
+    arithmetic, BN semantics, augmentation leak) visibly undershoots and
+    label leakage cannot overshoot.  Recorded in ACCURACY.json
+    (``mnist_low_snr_oracle``); asserted in tests/test_accuracy_oracle.py.
+    """
+    if n is None:
+        n = 60000 if train else 10000
+    x, y = _synthetic_arrays(n, (28, 28), 1, 10, (0xDA7A, 0), int(train))
+    # split-dependent seed stream, distinct from the draw stream above
+    rng = np.random.default_rng((0xDA7A, 2, int(train)))
+    flip = rng.random(n) < label_noise
+    y = np.where(flip, rng.integers(0, 10, n), y).astype(np.int64)
+    return x, y
 
 
 # ---------------------------------------------------------------------------
