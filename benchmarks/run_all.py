@@ -59,7 +59,8 @@ def _better(new: dict, old: dict) -> dict:
         # batch-scaling sweep) survive a ratchet replacement that did not
         # re-measure them
         for extra_key in ("throughput_scaling", "reference_batch_recording",
-                          "linear_only_recording", "remat_on_recording"):
+                          "linear_only_recording", "remat_on_recording",
+                          "speedup_vs_bf16_batch1"):
             if extra_key not in best:
                 loser = old if best is new else new
                 if extra_key in loser:
@@ -151,6 +152,7 @@ def main() -> None:
         "generate_int8": "transformer_lm_decode_int8_tokens_per_sec",
         "gen_latency": "transformer_lm_decode_batch1_tokens_per_sec",
         "gen_latency_int8": "transformer_lm_decode_batch1_int8_tokens_per_sec",
+        "gen_long_int8_cache": "transformer_lm_decode_long_context_int8_cache",
     }
     import bench  # repo-root headline (MNIST ConvNet) — ratchet a copy here
     results = []
@@ -168,7 +170,9 @@ def main() -> None:
                      ("generate", generate.run),
                      ("generate_int8", generate.run_int8),
                      ("gen_latency", generate.run_latency),
-                     ("gen_latency_int8", generate.run_latency_int8)):
+                     ("gen_latency_int8", generate.run_latency_int8),
+                     ("gen_long_int8_cache",
+                      generate.run_long_context_int8_cache)):
         try:
             r = fn()
         except Exception as e:  # record the failure, keep the rest running

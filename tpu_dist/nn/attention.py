@@ -168,19 +168,22 @@ class MultiheadSelfAttention(Module):
             p["out_bias"] = jnp.zeros((self.embed_dim,))
         return p
 
-    def _proj_weights(self, p, dtype):
-        """The qkv/out projection weights — overridden by the int8
-        inference subclass (nn.quant.QuantMultiheadSelfAttention) to
-        dequantize on the fly."""
-        return p["qkv_weight"], p["out_weight"]
+    def _qkv_proj(self, p, x):
+        """The fused qkv projection — overridden by the int8 inference
+        subclass (nn.quant.QuantMultiheadSelfAttention), which hoists its
+        per-channel scale to the (tiny) output instead of dequantizing the
+        (huge) weight."""
+        return F.linear(x, p["qkv_weight"], p.get("qkv_bias"))
+
+    def _out_proj(self, p, out):
+        return F.linear(out, p["out_weight"], p.get("out_bias"))
 
     def forward(self, x):
         from .module import _ctx
         ctx = _ctx()
         p = ctx.get_params(self._path)
         b, t, _ = x.shape
-        qkv_w, out_w = self._proj_weights(p, x.dtype)
-        qkv = F.linear(x, qkv_w, p.get("qkv_bias"))
+        qkv = self._qkv_proj(p, x)
         qkv = qkv.reshape(b, t, 3, self.num_heads, self.head_dim)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         if self.rope:
@@ -214,7 +217,19 @@ class MultiheadSelfAttention(Module):
             out = scaled_dot_product_attention(q, k, v, causal=self.causal,
                                                impl=self.attn_impl)
         out = out.reshape(b, t, self.embed_dim)
-        return F.linear(out, out_w, p.get("out_bias"))
+        return self._out_proj(p, out)
+
+    @staticmethod
+    def _quantize_kv(x):
+        """Symmetric per-(token, head) int8: x (B, t, H, D) -> (q int8,
+        scale (B, t, H) f32).  amax over the head dim only, so one outlier
+        token/head cannot flatten every other's resolution."""
+        xf = x.astype(jnp.float32)
+        amax = jnp.max(jnp.abs(xf), axis=-1)
+        scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+        q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127
+                     ).astype(jnp.int8)
+        return q, scale
 
     def _decode(self, ctx, q, k, v):
         """Cached attention step.  q/k/v: (B, t, H, D) with t the number of
@@ -222,31 +237,74 @@ class MultiheadSelfAttention(Module):
         state ``{"k": (B, Tmax, H, D), "v": ..., "index": ()}``; new keys
         land at [index, index+t) and queries see cache positions <= their
         own global position (cache slots past the index are masked, so the
-        zeros there never contribute)."""
+        zeros there never contribute).
+
+        With an int8 cache (``init_cache(dtype=jnp.int8)``) K/V are stored
+        quantized with per-(token, head) symmetric scales and the scales are
+        HOISTED out of both matmuls — scores multiply by ``k_scale`` on the
+        (t, Tmax) tile and probabilities by ``v_scale`` before the PV
+        matmul — so the big cache tensors cross HBM as int8 and are
+        converted in the MXU tile load, never materialized dequantized.
+        Long-context decode reads the cache, not the weights; halving its
+        bytes halves the bandwidth bill where it dominates."""
         st = ctx.get_state(self._path)
         index = st["index"]
-        k_cache = jax.lax.dynamic_update_slice(
-            st["k"], k.astype(st["k"].dtype), (0, index, 0, 0))
-        v_cache = jax.lax.dynamic_update_slice(
-            st["v"], v.astype(st["v"].dtype), (0, index, 0, 0))
         t = q.shape[1]
-        ctx.put_state(self._path, {"k": k_cache, "v": v_cache,
-                                   "index": index + t})
-        tmax = k_cache.shape[1]
+        int8_cache = st["k"].dtype == jnp.int8
+        if int8_cache:
+            kq, ks = self._quantize_kv(k)
+            vq, vs = self._quantize_kv(v)
+            st = dict(
+                st,
+                k=jax.lax.dynamic_update_slice(st["k"], kq, (0, index, 0, 0)),
+                v=jax.lax.dynamic_update_slice(st["v"], vq, (0, index, 0, 0)),
+                k_scale=jax.lax.dynamic_update_slice(
+                    st["k_scale"], ks, (0, index, 0)),
+                v_scale=jax.lax.dynamic_update_slice(
+                    st["v_scale"], vs, (0, index, 0)))
+        else:
+            st = dict(
+                st,
+                k=jax.lax.dynamic_update_slice(
+                    st["k"], k.astype(st["k"].dtype), (0, index, 0, 0)),
+                v=jax.lax.dynamic_update_slice(
+                    st["v"], v.astype(st["v"].dtype), (0, index, 0, 0)))
+        ctx.put_state(self._path, dict(st, index=index + t))
+        tmax = st["k"].shape[1]
         qpos = index + jnp.arange(t)[:, None]           # (t, 1) global
         kpos = jnp.arange(tmax)[None, :]                # (1, Tmax)
         mask = kpos <= qpos                             # causal + unwritten
-        return scaled_dot_product_attention(
-            q, k_cache.astype(q.dtype), v_cache.astype(q.dtype),
-            mask=mask, impl="dense")
+        if not int8_cache:
+            return scaled_dot_product_attention(
+                q, st["k"].astype(q.dtype), st["v"].astype(q.dtype),
+                mask=mask, impl="dense")
+        # hoisted-scale dense attention over the int8 cache
+        sm = 1.0 / math.sqrt(self.head_dim)
+        s = jnp.einsum("bthd,bshd->bhts", q, st["k"].astype(q.dtype),
+                       preferred_element_type=jnp.float32)
+        s = s * sm * jnp.transpose(st["k_scale"], (0, 2, 1))[:, :, None, :]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        pv = (p * jnp.transpose(st["v_scale"], (0, 2, 1))[:, :, None, :]
+              ).astype(q.dtype)
+        return jnp.einsum("bhts,bshd->bthd", pv, st["v"].astype(q.dtype),
+                          preferred_element_type=jnp.float32).astype(q.dtype)
 
     def init_cache(self, batch: int, max_len: int, dtype=jnp.float32):
-        """Per-layer KV cache entry (used via TransformerLM.init_cache)."""
-        return {"k": jnp.zeros((batch, max_len, self.num_heads,
-                                self.head_dim), dtype),
-                "v": jnp.zeros((batch, max_len, self.num_heads,
-                                self.head_dim), dtype),
-                "index": jnp.zeros((), jnp.int32)}
+        """Per-layer KV cache entry (used via TransformerLM.init_cache).
+        ``dtype=jnp.int8`` allocates the quantized cache layout: int8 K/V
+        plus float32 per-(token, head) scales (see :meth:`_decode`)."""
+        cache = {"k": jnp.zeros((batch, max_len, self.num_heads,
+                                 self.head_dim), dtype),
+                 "v": jnp.zeros((batch, max_len, self.num_heads,
+                                 self.head_dim), dtype),
+                 "index": jnp.zeros((), jnp.int32)}
+        if jnp.dtype(dtype) == jnp.int8:
+            cache["k_scale"] = jnp.zeros((batch, max_len, self.num_heads),
+                                         jnp.float32)
+            cache["v_scale"] = jnp.zeros((batch, max_len, self.num_heads),
+                                         jnp.float32)
+        return cache
 
     def __repr__(self):
         sp = (f", sequence_axis={self.sequence_axis!r}, mode={self.mode!r}"
