@@ -60,7 +60,8 @@ def _better(new: dict, old: dict) -> dict:
         # re-measure them
         for extra_key in ("throughput_scaling", "reference_batch_recording",
                           "linear_only_recording", "remat_on_recording",
-                          "speedup_vs_bf16_batch1"):
+                          "speedup_vs_bf16_batch1",
+                          "same_window_vs_dense_lm"):
             if extra_key not in best:
                 loser = old if best is new else new
                 if extra_key in loser:

@@ -137,12 +137,15 @@ def main():
         dev_aug = DeviceAugment.imagenet(
             args.image_size,
             dtype=jnp.float32 if args.no_bf16 else jnp.bfloat16)
+    # prefetch 3: three staged batches saturate slow H2D links (measured
+    # ~40 vs ~27-38 MB/s on this rig's tunnel) at negligible HBM cost —
+    # matches the recorded e2e row (benchmarks/imagenet_e2e.py)
     loader = DeviceLoader(
         DataLoader(ds, batch_size=world_batch // dist.get_num_processes(),
                    sampler=sampler, drop_last=True,
                    num_workers=args.num_workers,
                    to_float=args.host_augment),
-        group=pg, augment=dev_aug)
+        group=pg, augment=dev_aug, prefetch=3)
 
     total_step = len(loader.loader)
     start = datetime.now()
