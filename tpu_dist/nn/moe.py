@@ -139,6 +139,13 @@ class MoELayer(Module):
         top_k: experts consulted per token (1 = Switch, 2 = GShard default).
         capacity_factor: slack multiplier on the perfectly-balanced
             per-expert token budget; tokens past capacity are dropped.
+            NOTE dropping makes outputs depend on the BATCH COMPOSITION
+            (slot competition is a cumsum over every token in the call),
+            so e.g. KV-cache decode of a prefix will not bit-match the
+            full-sequence forward while drops occur.  For serving, use
+            ``capacity_factor >= num_experts / top_k`` — capacity then
+            equals the token count, nothing drops, and cached decode
+            equals the full forward exactly (tests/test_moe.py).
         normalize_gates: renormalize the k selected gate values to sum to 1
             (GShard semantics); off uses raw softmax probabilities (Switch).
         dispatch: ``"einsum"`` (GSPMD/ep-friendly dense dispatch tensors)
