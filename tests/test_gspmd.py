@@ -139,7 +139,11 @@ class TestTensorParallelGenerate:
             prompt, NamedSharding(mesh2d, P("data", None)))
         with attention_impl("dense"):  # Pallas custom calls can't be cut
             out = jax.jit(lambda p, t: model.generate(p, t, 8))(sp, sprompt)
+            # composes with the quantized KV cache: still token-exact
+            out_i8 = jax.jit(lambda p, t: model.generate(
+                p, t, 8, cache_dtype=jnp.int8))(sp, sprompt)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+        np.testing.assert_array_equal(np.asarray(out_i8), np.asarray(ref))
 
 
 class TestViTTensorParallel:
