@@ -209,18 +209,35 @@ def run_long_context_int8_cache(prompt_len: int = 7680, gen_long: int = 384,
         rows[name] = {"ms_per_token": round(sec * 1e3, 3),
                       "tokens_per_sec": round(1.0 / sec, 1),
                       "gross_timing_fallback_incl_prefill": gross}
-    speed = (rows["int8_cache"]["tokens_per_sec"]
-             / max(rows["bf16_cache"]["tokens_per_sec"], 1e-9))
-    return {
+    flags = {name: r["gross_timing_fallback_incl_prefill"]
+             for name, r in rows.items()}
+    if any(flags.values()):
+        # a gross-fallback rate includes the multi-second 7680-token
+        # prefill (where the int8 cache buys nothing): if the flags
+        # disagree the ratio compares incomparable quantities, and if
+        # BOTH fell back it is prefill-dominated (~1.0x regardless of the
+        # real decode speedup) — either way publish null, not a wrong
+        # number
+        speed = None
+        note = ("speedup invalid: gross_timing_fallback rates include "
+                f"prefill ({flags}); rerun under less contention")
+    else:
+        speed = round(rows["int8_cache"]["tokens_per_sec"]
+                      / max(rows["bf16_cache"]["tokens_per_sec"], 1e-9), 3)
+        note = None
+    out = {
         "metric": "transformer_lm_decode_long_context_int8_cache",
         "value": rows["int8_cache"]["tokens_per_sec"],
         "unit": f"tokens/sec (batch 1, prompt {prompt_len}, int8 "
                 "weights+cache)",
-        "int8_cache_speedup_vs_bf16_cache": round(speed, 3),
+        "int8_cache_speedup_vs_bf16_cache": speed,
         "prompt_len": prompt_len,
         **rows,
         "n_chips": 1,
     }
+    if note is not None:
+        out["speedup_note"] = note
+    return out
 
 
 def run_latency_int8() -> dict:

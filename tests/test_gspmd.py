@@ -142,8 +142,36 @@ class TestTensorParallelGenerate:
             # composes with the quantized KV cache: still token-exact
             out_i8 = jax.jit(lambda p, t: model.generate(
                 p, t, 8, cache_dtype=jnp.int8))(sp, sprompt)
-        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
-        np.testing.assert_array_equal(np.asarray(out_i8), np.asarray(ref))
+        if jax.devices()[0].platform == "cpu":
+            # the virtual CPU mesh reduces deterministically, so greedy
+            # tokens are bit-exact vs the single-device decode
+            np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+            np.testing.assert_array_equal(np.asarray(out_i8),
+                                          np.asarray(ref))
+        else:
+            # real-chip collectives may reorder reductions; a greedy
+            # near-tie could flip a token and cascade, so demand logits
+            # agreement plus a weaker decode-output contract (shape,
+            # vocab range, prompt passthrough, majority token agreement)
+            # instead of bit-exact tokens
+            lg_tp = jax.jit(model.apply)(sp, sprompt)
+            lg_ref = model.apply(jax.device_get(sp), prompt)
+            np.testing.assert_allclose(np.asarray(lg_tp),
+                                       np.asarray(lg_ref),
+                                       rtol=2e-2, atol=2e-2)
+            tp_len = prompt.shape[1]
+            for o in (np.asarray(out), np.asarray(out_i8)):
+                assert o.shape == np.asarray(ref).shape
+                assert ((o >= 0) & (o < vocab)).all()
+                np.testing.assert_array_equal(o[:, :tp_len],
+                                              np.asarray(prompt))
+                # agreement over the GENERATED region only (the prompt
+                # passthrough is already pinned above): garbage decode
+                # agrees at ~1/vocab, while a single legitimate near-tie
+                # flip mid-sequence still leaves the prefix agreeing
+                agree = (o[:, tp_len:]
+                         == np.asarray(ref)[:, tp_len:]).mean()
+                assert agree >= 0.25, f"decode diverged: {agree:.2f} agree"
 
 
 class TestViTTensorParallel:

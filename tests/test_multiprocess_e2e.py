@@ -181,6 +181,22 @@ def test_monitored_barrier_single_process_noop():
         dist.destroy_process_group()
 
 
+def test_monitored_barrier_rejects_subgroups():
+    """The store keys are not namespaced by group, so a subgroup barrier
+    would collide with (and misdiagnose against) the default group's —
+    the documented contract is default-group-only, enforced by a raise."""
+    import pytest
+
+    import tpu_dist.dist as dist
+    dist.init_process_group(backend="cpu")
+    try:
+        sub = dist.new_group(ranks=[0])
+        with pytest.raises(ValueError, match="default group"):
+            dist.monitored_barrier(group=sub)
+    finally:
+        dist.destroy_process_group()
+
+
 _ABORT_WORKER = textwrap.dedent("""
     import os, sys, time
     os.environ["JAX_PLATFORMS"] = "cpu"

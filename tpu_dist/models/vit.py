@@ -126,10 +126,15 @@ class VisionTransformer(nn.Module):
 
     def init(self, key):
         from ..nn import init as I
-        params = super().init(key)
+        # split before handing a key to Module.init: the re-init stream
+        # below must be independent of the base stream, or a fold_in
+        # collision could correlate a re-initialized leaf with a kept one
+        # (e.g. out_weight, which keeps its Module.init draw)
+        init_key, reinit_key = jax.random.split(key)
+        params = super().init(init_key)
 
         def k(name):
-            return jax.random.fold_in(key, _stable_fold(name))
+            return jax.random.fold_in(reinit_key, _stable_fold(name))
 
         # torchvision zero-initializes the classification head
         params["head"]["weight"] = jnp.zeros_like(params["head"]["weight"])
