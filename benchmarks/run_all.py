@@ -15,13 +15,15 @@ import os
 import sys
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
 # v5e bf16 peak is ~197 TFLOPs/chip; any row whose model-FLOPs accounting
-# implies more than this CAP is a timing artifact (the scan-differenced
+# implies more than this cap is a timing artifact (the scan-differenced
 # minima can cross under heavy drift), not a measurement — the ratchet
-# must never lock one in as a best.
-_TFLOPS_CAP = 185.0
+# must never lock one in as a best.  Shared with bench.py's in-loop
+# estimator gate so the two can never disagree.
+from bench import V5E_TFLOPS_CAP as _TFLOPS_CAP  # noqa: E402
 
 
 _HBM_GBPS_CAP = 819.0  # v5e HBM bandwidth; implied reads above it are
