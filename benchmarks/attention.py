@@ -13,17 +13,16 @@ causal factor-of-2 saving is NOT credited — standard flash accounting) plus
 the flash:dense speedup.  Long sequences where dense's scores no longer fit
 are flash-only rows (that's the point of the kernel).
 
-Why the seq-2048 row reads lower than 8k/32k (r4 analysis): with 1024²
-tiles the kernel executes 3 of 4 grid tiles at T=2048 (skipping the one
-fully-above-diagonal tile) but 36 of 64 at T=8192 — since the accounting
-charges the FULL T² matrix, the uncredited causal skip inflates the
-reported TFLOPs by 64/36 = 1.78x at 8k but only 4/3 at 2k.  Measured
-per-executed-tile time is the same ~5 us at both lengths, i.e. the MXU
-utilization is flat across the curve; an interleaved same-window block
-sweep (1024²/512²/256²/rectangular) confirmed 1024² is the fastest config
-at 2048 and that smaller tiles run at half the per-area rate (tile-switch
-overhead), so capturing more causal skip with finer tiles does not pay.
-The curve's shape is the accounting convention, not a kernel deficiency.
+Round-5: the seq-2048 deficit identified in r4 (diagonal 1024² tiles
+2/3-useful) is fixed by the diagonal/off-diagonal split
+(ops/flash_attention._split_lse, auto-dispatched at exactly 2 bands):
+unmasked full off-diagonal tiles + a batched within-band causal call at
+half-size tiles, merged by the blockwise-lse identity with a single
+custom VJP over the merged (o, lse).  Same-window interleaved A/B on the
+v5e measured 2.48x fwd / 1.68x fwd+bwd at 2048; at 3+ bands the split
+LOSES (0.5-0.8x — dead off-diag grid slots still DMA their tiles), so
+8k/32k keep the single causal call, whose uncredited causal-skip
+accounting already inflates reported TFLOPs by 64/36 there.
 """
 
 from __future__ import annotations
@@ -166,15 +165,15 @@ def run(b: int = 4, h: int = 8, d: int = 64) -> dict:
         "shape": {"batch": b, "heads": h, "head_dim": d},
         "rows": rows,
         "curve_shape_note": (
-            "the seq-2048 row reads lower than 8k/32k because the "
-            "accounting charges the full T^2 matrix while the kernel "
-            "executes only sub-diagonal tiles: the uncredited causal skip "
-            "inflates reported TFLOPs by 64/36 = 1.78x at 8k but only "
-            "4/3 = 1.33x at 2k with 1024^2 tiles; measured "
-            "per-executed-tile time is flat (~5 us) across the curve, and "
-            "an interleaved same-window block sweep confirmed 1024^2 is "
-            "the fastest config at 2048 (finer tiles run at half the "
-            "per-area rate)"),
+            "seq 2048 runs the diagonal/off-diagonal split "
+            "(_split_lse, auto at exactly 2 bands): same-window "
+            "interleaved A/B measured 2.48x fwd / 1.68x fwd+bwd vs the "
+            "single causal call, fixing the r4 finding that 1024^2 "
+            "diagonal tiles were 2/3-useful there; 8k/32k keep the "
+            "single call (the split loses 0.5-0.8x at 3+ bands: dead "
+            "off-diag grid slots still DMA their tiles), and their "
+            "reported TFLOPs still carry the uncredited causal-skip "
+            "inflation (64/36 at 8k)"),
     }
 
 

@@ -71,16 +71,35 @@ def _masked_scores(q, k, sm_scale, tk, causal, q_lo, k_lo):
     """(block_q, block_k) score tile on the MXU (f32 accumulation), with
     out-of-range and above-diagonal entries set to _NEG_INF.  The single
     source of the score/mask convention shared by the forward and both
-    backward kernels."""
+    backward kernels.
+
+    ``causal`` is three-valued: ``True`` masks above the diagonal,
+    ``False`` doesn't, and ``"offdiag"`` also doesn't — its tiles sit
+    strictly below the diagonal band by the grid predicate, so per-element
+    causal mask math (two iotas + compare + select per tile) is skipped
+    entirely; only the K padding range check remains."""
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
     s = s * sm_scale
     kpos = k_lo + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
     mask = kpos < tk
-    if causal:
+    if causal is True:
         qpos = q_lo + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
         mask = mask & (kpos <= qpos)
     return jnp.where(mask, s, _NEG_INF), mask
+
+
+def _tile_live(causal, q_lo, k_lo, block_q, block_k):
+    """Grid predicate: does tile (q_lo, k_lo) contribute any unmasked
+    entries?  ``True`` = tiles intersecting or below the diagonal;
+    ``"offdiag"`` = tiles STRICTLY below the diagonal band (the masked
+    diagonal tiles are handled by a separate finer-tiled causal call —
+    see _split_lse); ``False`` = all tiles."""
+    if causal is True:
+        return k_lo <= q_lo + block_q - 1
+    if causal == "offdiag":
+        return k_lo + block_k <= q_lo
+    return k_lo >= 0  # trivially true (kernel body must sit under pl.when)
 
 
 def _tile_probs(q_ref, k_ref, lse_ref, sm_scale, tk, causal, q_lo, k_lo):
@@ -131,10 +150,11 @@ def _make_fwd_kernel(sm_scale, tk, block_q, block_k, causal):
             acc_scr[:] = acc_scr[:] * alpha + pv
             m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
 
-        # tiles entirely above the diagonal contribute nothing; non-causal
-        # uses a trivially-true predicate (see _use_interpret for why the
-        # body must be under pl.when either way)
-        @pl.when(k_lo <= q_lo + block_q - 1 if causal else ki >= 0)
+        # tiles contributing nothing (above the diagonal / diagonal band)
+        # are predicated off; non-causal uses a trivially-true predicate
+        # (see _use_interpret for why the body must be under pl.when
+        # either way)
+        @pl.when(_tile_live(causal, q_lo, k_lo, block_q, block_k))
         def _():
             body()
 
@@ -228,7 +248,7 @@ def _make_dq_kernel(sm_scale, tk, block_q, block_k, causal):
                 ds, k, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
 
-        @pl.when(k_lo <= q_lo + block_q - 1 if causal else ki >= 0)
+        @pl.when(_tile_live(causal, q_lo, k_lo, block_q, block_k))
         def _():
             body()
 
@@ -273,7 +293,7 @@ def _make_dkv_kernel(sm_scale, tk, block_q, block_k, causal):
                 ds, q, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
 
-        @pl.when(q_lo + block_q - 1 >= k_lo if causal else qi >= 0)
+        @pl.when(_tile_live(causal, q_lo, k_lo, block_q, block_k))
         def _():
             body()
 
@@ -286,7 +306,7 @@ def _make_dkv_kernel(sm_scale, tk, block_q, block_k, causal):
 
 
 def _bwd_call(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k,
-              dlse=None):
+              dlse=None, delta=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -300,10 +320,13 @@ def _bwd_call(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k,
     # caller differentiates through lse too (ring-attention merge), its
     # cotangent enters the same place with opposite sign:
     # dL/ds_ij = p_ij * (dp_ij - delta_i + dlse_i), so fold it into delta.
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1, keepdims=True)                  # (BH, Tq, 1)
-    if dlse is not None:
-        delta = delta - dlse.astype(jnp.float32)
+    # The split-causal backward passes a precomputed ``delta`` so its two
+    # region calls share one rowsum pass.
+    if delta is None:
+        delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                        axis=-1, keepdims=True)              # (BH, Tq, 1)
+        if dlse is not None:
+            delta = delta - dlse.astype(jnp.float32)
 
     qp = jnp.pad(q, ((0, 0), (0, tqp - tq), (0, dp - d)))
     kp = jnp.pad(k, ((0, 0), (0, tkp - tk), (0, dp - d)))
@@ -377,8 +400,114 @@ def _flash_lse_bwd(causal, sm_scale, block_q, block_k, res, cts):
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
+def _merge_lse(o_a, lse_a, o_b, lse_b):
+    """Exact blockwise-attention merge of two partial results over disjoint
+    KV sets (the identity from flash_attention_with_lse's docstring), in
+    f32.  Plain jnp: autodiff routes the cotangents into both partials'
+    custom VJPs (including dlse), exactly like ring_attention's merge."""
+    m = jnp.maximum(lse_a, lse_b)
+    w_a = jnp.exp(lse_a - m)
+    w_b = jnp.exp(lse_b - m)
+    den = w_a + w_b
+    o = (o_a.astype(jnp.float32) * w_a + o_b.astype(jnp.float32) * w_b) / den
+    return o.astype(o_a.dtype), m + jnp.log(den)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _split_lse(q, k, v, sm_scale, block_q, block_k):
+    """Causal flash attention as two kernel calls per pass whose executed
+    tile area ≈ the useful (unmasked) score area.
+
+    A single causal call sweeps every tile touching the diagonal with
+    full-size blocks, so at seq = 2·block the three executed 1024² tiles
+    are only 2/3 useful (the two diagonal tiles are half masked) — the
+    measured TFLOPs deficit at 2048 vs 8k (BENCH_EXTENDED
+    curve_shape_note).  Split instead:
+
+    - **off-diagonal**: tiles STRICTLY below the diagonal band (mode
+      ``"offdiag"``) — full blocks, zero masked area, and no per-element
+      causal mask math at all;
+    - **diagonal band**: each q block attends causally within its own
+      band, which is exactly a BATCHED causal attention over
+      (BH·n_bands, block_q) sequences — the same kernel at half-size
+      blocks, so the masked waste per band shrinks from block²/2 to
+      block²/4 (minus the skipped above-diagonal sub-tile);
+
+    merged with the exact blockwise-lse identity.  Executed-area ratio vs
+    the single call: (n² + n/2) / (n² + n) per n = T/block — a 1/6 area
+    cut at n=2, vanishing as n grows (the 8k curve point was already
+    ~90% useful); measured 2.4x fwd at 2048 same-window (the off-diag
+    tiles also shed their mask/select VPU work).
+
+    The custom VJP is at THIS level, not composed from two _flash_lse
+    VJPs: the backward recomputes p = exp(s - lse) from the MERGED lse in
+    both regions (the standard flash recurrence is oblivious to how the
+    forward was tiled), so the residuals are exactly the single-call ones
+    (q, k, v, o, lse) — composing custom-VJP calls through the merge
+    instead saves two extra partial (o, lse) pairs and differentiates the
+    elementwise merge, which measured as a complete wash at 2048.
+
+    Inputs are the kernel-internal (BH, T, D) layout; requires tq == tk
+    and block_q | tq (the dispatch condition in
+    flash_attention_with_lse)."""
+    return _split_fwd_impl(q, k, v, sm_scale, block_q, block_k)
+
+
+def _to_bands(x, n_bands, band):
+    bh = x.shape[0]
+    return x.reshape(bh * n_bands, band, x.shape[-1])
+
+
+def _split_fwd_impl(q, k, v, sm_scale, block_q, block_k):
+    bh, tq, d = q.shape
+    n_bands = tq // block_q
+    o_diag, lse_diag = _fwd_call(
+        _to_bands(q, n_bands, block_q), _to_bands(k, n_bands, block_q),
+        _to_bands(v, n_bands, block_q), True, sm_scale,
+        block_q // 2, block_q // 2)
+    o_off, lse_off = _fwd_call(q, k, v, "offdiag", sm_scale,
+                               block_q, block_k)
+    return _merge_lse(o_off, lse_off, o_diag.reshape(bh, tq, d),
+                      lse_diag.reshape(bh, tq, 1))
+
+
+def _split_fwd(q, k, v, sm_scale, block_q, block_k):
+    o, lse = _split_fwd_impl(q, k, v, sm_scale, block_q, block_k)
+    return (o, lse), (q, k, v, o, lse)
+
+
+def _split_bwd(sm_scale, block_q, block_k, res, cts):
+    q, k, v, o, lse = res
+    do, dlse = cts
+    bh, tq, d = q.shape
+    n_bands = tq // block_q
+    # one shared softmax-jacobian correction (see _bwd_call): both region
+    # calls recompute p from the same merged lse, so they share delta too
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
+    dq_off, dk_off, dv_off = _bwd_call(
+        q, k, v, o, lse, do, "offdiag", sm_scale, block_q, block_k,
+        delta=delta)
+
+    def bands(x):
+        return _to_bands(x, n_bands, block_q)
+
+    dq_d, dk_d, dv_d = _bwd_call(
+        bands(q), bands(k), bands(v), bands(o), bands(lse), bands(do),
+        True, sm_scale, block_q // 2, block_q // 2, delta=bands(delta))
+    return (dq_off + dq_d.reshape(bh, tq, d),
+            dk_off + dk_d.reshape(bh, tq, d),
+            dv_off + dv_d.reshape(bh, tq, d))
+
+
+_split_lse.defvjp(_split_fwd, _split_bwd)
+
+
 def flash_attention_with_lse(q, k, v, causal: bool = False, sm_scale=None,
-                             block_q: int = 1024, block_k: int = 1024):
+                             block_q: int = 1024, block_k: int = 1024,
+                             split_diag=None):
     """Flash attention returning ``(out, lse)``.
 
     ``out``: (..., Tq, H, D) like :func:`flash_attention`; ``lse``:
@@ -408,20 +537,49 @@ def flash_attention_with_lse(q, k, v, causal: bool = False, sm_scale=None,
             f"got q={q.shape}, k={k.shape}, v={v.shape}")
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
+    if not isinstance(causal, str):
+        # normalize truthy values (np.bool_, 1) to the literal bool the
+        # kernels' three-valued dispatch (`causal is True`) relies on
+        causal = bool(causal)
 
     def to3(x, t):
         x = x.reshape(-1, t, h, d)
         return jnp.swapaxes(x, 1, 2).reshape(-1, t, d)
 
-    o3, lse3 = _flash_lse(to3(q, tq), to3(k, tk), to3(v, tk), causal,
-                          float(sm_scale), int(block_q), int(block_k))
+    # ``split_diag`` (None = auto): causal self-attention spanning EXACTLY
+    # two full blocks runs as the diagonal/off-diagonal two-call split
+    # (_split_lse) so executed tile area ≈ useful area.  Same-window
+    # interleaved A/B on the v5e: 2.48x fwd / 1.68x fwd+bwd at seq 2048
+    # (2 bands), but 0.5-0.8x at 4096/8192 — with 3+ bands the off-diag
+    # call's swept-but-dead grid slots (the pipeline still DMAs tiles that
+    # pl.when skips) plus the extra call overhead outweigh the shrinking
+    # masked-area saving, so the gate is n_bands == 2 exactly
+    bq_eff, bk_eff = _clamp_blocks(q.dtype, tq, tk, block_q, block_k)
+    if split_diag is None:
+        split_diag = (causal is True and tq == tk and bq_eff == bk_eff
+                      and tq == 2 * bq_eff)
+    elif split_diag:
+        # explicit opt-in: the split hardcodes causal self-attention
+        # semantics, so reject configurations it would silently get wrong
+        if causal is not True or tq != tk or tq % bq_eff:
+            raise ValueError(
+                "split_diag=True requires causal=True self-attention "
+                f"(tq == tk) with block_q dividing tq; got causal={causal}, "
+                f"tq={tq}, tk={tk}, effective block_q={bq_eff}")
+    if split_diag:
+        o3, lse3 = _split_lse(to3(q, tq), to3(k, tk), to3(v, tk),
+                              float(sm_scale), bq_eff, bk_eff)
+    else:
+        o3, lse3 = _flash_lse(to3(q, tq), to3(k, tk), to3(v, tk), causal,
+                              float(sm_scale), int(block_q), int(block_k))
     o = jnp.swapaxes(o3.reshape(-1, h, tq, d), 1, 2).reshape(*lead, tq, h, d)
     lse = jnp.swapaxes(lse3.reshape(-1, h, tq), 1, 2)       # (B, Tq, H)
     return o, lse.reshape(*lead, tq, h)
 
 
 def flash_attention(q, k, v, causal: bool = False, sm_scale=None,
-                    block_q: int = 1024, block_k: int = 1024):
+                    block_q: int = 1024, block_k: int = 1024,
+                    split_diag=None):
     """Flash attention.  ``q``: (..., Tq, H, D); ``k, v``: (..., Tk, H, D).
 
     Drop-in for :func:`tpu_dist.nn.attention.scaled_dot_product_attention`
@@ -437,4 +595,5 @@ def flash_attention(q, k, v, causal: bool = False, sm_scale=None,
     """
     return flash_attention_with_lse(q, k, v, causal=causal,
                                     sm_scale=sm_scale, block_q=block_q,
-                                    block_k=block_k)[0]
+                                    block_k=block_k,
+                                    split_diag=split_diag)[0]
