@@ -35,7 +35,7 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_WORLD_SIZES = (1, 2, 4, 8, 16, 32)  # BASELINE.md north star: 1->32
 
 
-def _measure(per_device_batch: int = 8, steps: int = 6,
+def _measure(per_device_batch: int = 32, steps: int = 6,
              reps: int = 3, world_sizes=DEFAULT_WORLD_SIZES) -> dict:
     """Run inside a process whose backend has >= max(world_sizes) devices."""
     import jax
@@ -74,20 +74,19 @@ def _measure(per_device_batch: int = 8, steps: int = 6,
             str(n): round(n * t1 / times[n], 3) for n in times},
         "per_device_batch": per_device_batch,
         "note": "1-core host: ideal t_N = N*t_1; see module docstring. "
-                "Overhead RATIOS depend on the per-device work size — "
-                "smaller batches make the fixed collective/dispatch "
-                "overhead a larger fraction — so efficiencies recorded at "
-                "different per_device_batch values are not comparable "
-                "(r1-r3 rows used 128 over worlds 1..8; this row uses 8 "
-                "over 1..32 so the 32x-serialized rung finishes).",
+                "Overhead RATIOS depend on the per-device work size, so "
+                "the whole 1..32 ladder is recorded at ONE fixed "
+                "per-device batch (r5 verdict #8: the r1-r3 rows used 128 "
+                "over worlds 1..8 and an interim row used 8 over 1..32; "
+                "this single consistent series replaces both).",
     }
 
 
-def run(per_device_batch: int = 8, steps: int = 6, reps: int = 3,
+def run(per_device_batch: int = 32, steps: int = 6, reps: int = 3,
         world_sizes=DEFAULT_WORLD_SIZES) -> dict:
-    # defaults sized so the n=32 rung (32x serialized compute on the 1-core
-    # host) completes well inside the child timeout; the measured quantity
-    # is an overhead RATIO, insensitive to the per-device work size
+    # batch 32 per device: one consistent production-like size across the
+    # whole 1..32 ladder (r5 verdict #8), still small enough that the
+    # 32x-serialized rung finishes inside the child timeout
     """Re-exec on a forced max(world_sizes)-device CPU backend and return
     the measurement."""
     code = (

@@ -1,0 +1,143 @@
+"""Grouped-matmul kernels (ops/gmm.py) vs numpy per-group references.
+
+Interpret mode on CPU (conftest forces the platform): same kernel code as
+the TPU Mosaic path.  The MoE-level integration (dropless dispatch equals
+the no-drop capacity function) lives in tests/test_moe.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import importlib
+
+from tpu_dist.ops import grouped_linear, tgmm
+from tpu_dist.ops.gmm import gmm
+
+# the module object (``from tpu_dist.ops import gmm`` would resolve to the
+# same-named FUNCTION re-exported by the package __init__)
+gmm_mod = importlib.import_module("tpu_dist.ops.gmm")
+
+# compile-heavy file: excluded from the fast tier (`pytest -m "not slow"`)
+pytestmark = pytest.mark.slow
+
+B = 8  # row-block size for the tiny shapes here
+
+
+def _case(rng, e=3, d=16, h=24, blocks_per_group=(2, 1, 3), live_rows=None):
+    """Sorted block-aligned layout: group g owns blocks_per_group[g]
+    row blocks; the last allocated block of each group is half padding."""
+    nb_live = sum(blocks_per_group)
+    nb = nb_live + 2                       # two dead tail blocks
+    m = nb * B
+    x = np.zeros((m, d), np.float32)
+    bg = []
+    row_group = np.full(m, -1)
+    r = 0
+    for g, nblk in enumerate(blocks_per_group):
+        n_rows = nblk * B - B // 2         # ragged: half-block padding
+        x[r:r + n_rows] = rng.standard_normal((n_rows, d))
+        row_group[r:r + n_rows] = g
+        bg += [g] * nblk
+        r += nblk * B
+    bg += [e - 1] * (nb - nb_live)         # dead tail carries last group
+    w = rng.standard_normal((e, d, h)).astype(np.float32)
+    bias = rng.standard_normal((e, h)).astype(np.float32)
+    return (jnp.asarray(x), jnp.asarray(w), jnp.asarray(bias),
+            jnp.asarray(bg, jnp.int32), jnp.int32(nb_live), row_group)
+
+
+def _ref_out(x, w, bias, row_group):
+    out = np.zeros((x.shape[0], w.shape[2]), np.float32)
+    for i, g in enumerate(row_group):
+        if g >= 0:
+            out[i] = np.asarray(x)[i] @ np.asarray(w)[g] + np.asarray(bias)[g]
+    return out
+
+
+def test_gmm_matches_per_group_reference(rng):
+    x, w, bias, bg, n_live, row_group = _case(rng)
+    out = gmm(x, w, bg, n_live, bias=bias, block_rows=B, block_h=16)
+    ref = _ref_out(x, w, bias, row_group)
+    # pad rows inside live blocks get bias[g] (harmless — the combine
+    # never reads them); compare live rows only, plus dead-tail zeros
+    live = row_group >= 0
+    np.testing.assert_allclose(np.asarray(out)[live], ref[live],
+                               atol=1e-5, rtol=1e-5)
+    dead_tail = np.arange(x.shape[0]) >= int(n_live) * B
+    np.testing.assert_array_equal(np.asarray(out)[dead_tail], 0.0)
+
+
+def test_tgmm_matches_per_group_reference(rng):
+    x, w, bias, bg, n_live, row_group = _case(rng)
+    dy = jnp.asarray(rng.standard_normal((x.shape[0], w.shape[2]))
+                     .astype(np.float32))
+    # zero the pad rows of dy (the grouped_linear contract)
+    dy = dy * jnp.asarray((row_group >= 0)[:, None].astype(np.float32))
+    dw, db = tgmm(x, dy, bg, w.shape[0], block_rows=B, block_h=16,
+                  with_rowsum=True)
+    for g in range(w.shape[0]):
+        rows = row_group == g
+        np.testing.assert_allclose(
+            np.asarray(dw)[g], np.asarray(x)[rows].T @ np.asarray(dy)[rows],
+            atol=1e-5, rtol=1e-5, err_msg=f"dw[{g}]")
+        np.testing.assert_allclose(
+            np.asarray(db)[g], np.asarray(dy)[rows].sum(0),
+            atol=1e-5, rtol=1e-5, err_msg=f"db[{g}]")
+
+
+@pytest.mark.parametrize("wide", [False, True])
+def test_grouped_linear_grads(rng, wide):
+    """Autodiff through grouped_linear equals the dense per-group
+    reference — both tgmm orientations (the d > h transpose trick)."""
+    d, h = (24, 16) if wide else (16, 24)
+    x, w, bias, bg, n_live, row_group = _case(rng, d=d, h=h)
+    present = jnp.asarray(np.bincount(row_group[row_group >= 0],
+                                      minlength=w.shape[0]) > 0)
+    cot = rng.standard_normal((x.shape[0], h)).astype(np.float32)
+    cot[row_group < 0] = 0.0               # combine never reads pad rows
+    cot = jnp.asarray(cot)
+
+    def f(x, w, bias):
+        return jnp.vdot(grouped_linear(x, w, bias, bg, n_live, present,
+                                       B, 16), cot)
+
+    def ref(x, w, bias):
+        rg = jnp.asarray(np.maximum(row_group, 0))
+        mask = jnp.asarray((row_group >= 0).astype(np.float32))[:, None]
+        out = (jnp.einsum("md,mdh->mh", x, w[rg]) + bias[rg]) * mask
+        return jnp.vdot(out, cot)
+
+    g = jax.grad(f, argnums=(0, 1, 2))(x, w, bias)
+    gr = jax.grad(ref, argnums=(0, 1, 2))(x, w, bias)
+    for a, b, name in zip(g, gr, ("dx", "dw", "db")):
+        if name == "dx":
+            live = row_group >= 0          # pad-row dx is unused by the
+            a, b = np.asarray(a)[live], np.asarray(b)[live]  # dispatch VJP
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4, err_msg=name)
+
+
+def test_gmm_fused_activation(rng):
+    """activation= applies on the f32 accumulator in-kernel, equal to the
+    composition outside."""
+    x, w, bias, bg, n_live, row_group = _case(rng)
+    fused = gmm(x, w, bg, n_live, bias=bias, block_rows=B, block_h=16,
+                activation=jax.nn.gelu)
+    outside = jax.nn.gelu(gmm(x, w, bg, n_live, bias=bias, block_rows=B,
+                              block_h=16))
+    live = row_group >= 0
+    np.testing.assert_allclose(np.asarray(fused)[live],
+                               np.asarray(outside)[live], atol=1e-6)
+
+
+def test_block_autoshrink_preserves_numerics(rng, monkeypatch):
+    """_fit_blocks splitting caller blocks (VMEM pressure) must expand the
+    block→group map transparently — force it with a tiny budget."""
+    x, w, bias, bg, n_live, row_group = _case(rng)
+    full = gmm(x, w, bg, n_live, bias=bias, block_rows=B, block_h=16)
+    monkeypatch.setattr(gmm_mod, "_VMEM_BUDGET", 16 * 1024)
+    shrunk = gmm(x, w, bg, n_live, bias=bias, block_rows=B, block_h=16)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(shrunk),
+                               atol=1e-6)

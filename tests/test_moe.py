@@ -54,7 +54,7 @@ def _naive_moe(layer, p, x):
     return out
 
 
-@pytest.mark.parametrize("dispatch", ["einsum", "gather"])
+@pytest.mark.parametrize("dispatch", ["einsum", "gather", "dropless"])
 @pytest.mark.parametrize("top_k,normalize", [(1, False), (2, True),
                                              (2, False)])
 def test_moe_matches_per_token_reference(rng, top_k, normalize, dispatch):
@@ -86,6 +86,53 @@ def test_moe_gather_dispatch_matches_einsum(rng, top_k):
     gg = jax.grad(loss(lg), argnums=(0, 1))(params, x)
     for a, b in zip(jax.tree.leaves(ge), jax.tree.leaves(gg)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
+@pytest.mark.parametrize("top_k", [1, 2])
+def test_moe_dropless_matches_no_drop_gather(rng, top_k):
+    """Dropless (sort + grouped matmul, ops/gmm.py) computes exactly the
+    no-drop capacity function — forward and gradients (params AND input),
+    with a token count past 256 (int32 rank bookkeeping) and the natural
+    routing imbalance of an untrained router (ragged segment sizes, some
+    experts possibly empty)."""
+    n = 700
+    lnd, params = _layer(top_k=top_k, dispatch="gather")  # cf=1e9: no drops
+    ldl, _ = _layer(top_k=top_k, dispatch="dropless")
+    x = jnp.asarray(rng.standard_normal((n, DIM)).astype(np.float32))
+
+    def loss(layer):
+        return lambda p, xx: (layer.apply(p, xx, state={})[0] ** 2).sum()
+
+    y_nd = lnd.apply(params, x, state={})[0]
+    y_dl = ldl.apply(params, x, state={})[0]
+    np.testing.assert_allclose(np.asarray(y_nd), np.asarray(y_dl),
+                               atol=1e-5)
+    g_nd = jax.grad(loss(lnd), argnums=(0, 1))(params, x)
+    g_dl = jax.grad(loss(ldl), argnums=(0, 1))(params, x)
+    for a, b in zip(jax.tree.leaves(g_nd), jax.tree.leaves(g_dl)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4,
+                                   rtol=1e-5)
+
+
+def test_moe_dropless_extreme_imbalance(rng):
+    """All tokens routed to one expert (all-zero router logits tie-break
+    to expert 0): nothing is dropped — the defining dropless property —
+    and empty experts get exactly zero weight gradients (the
+    unwritten-tile masking path)."""
+    layer, params = _layer(top_k=1, dispatch="dropless")
+    params[""]["router"] = jnp.zeros_like(params[""]["router"])
+    x = jnp.asarray(rng.standard_normal((64, DIM)).astype(np.float32))
+    y = layer.apply(params, x)
+    # every token got its expert-0 output at the uniform-softmax gate 1/E
+    p = params[""]
+    hid = jax.nn.gelu(x @ p["w1"][0] + p["b1"][0])
+    ref = np.asarray(hid @ p["w2"][0] + p["b2"][0]) / E
+    np.testing.assert_allclose(np.asarray(y), ref, atol=1e-5)
+    g = jax.grad(lambda p: (layer.apply(p, x) ** 2).sum())(params)
+    gw1 = np.asarray(g[""]["w1"])
+    assert np.abs(gw1[0]).max() > 0
+    np.testing.assert_array_equal(gw1[1:], 0.0)  # empty experts masked
+    np.testing.assert_array_equal(np.asarray(g[""]["b2"])[1:], 0.0)
 
 
 def test_moe_batch_shape_and_state(rng):

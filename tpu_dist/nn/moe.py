@@ -31,6 +31,19 @@ TPU-first design — routing as dense einsums, not gather/scatter:
     data scatter.  ``O(k*N*d)`` — use for single-device and shard_map/DDP
     execution (layer internals are per-shard local there), where it is
     strictly cheaper; prefer ``"einsum"`` under a GSPMD 'expert' axis.
+  * ``"dropless"`` — MegaBlocks-style: rows sorted by expert (the routing
+    cumsum doubles as a counting sort — no argsort, which alone measures
+    ~5 ms at 16k rows on v5e), each expert run over its exact contiguous
+    segment by the grouped-matmul kernels (ops/gmm.py), segments padded
+    only to the row-block size.  No capacity, no drops, and the output
+    never depends on batch composition.  Measured honestly (quiet-chip
+    interleaved A/B at GPT-2-small MoE shapes): ~0.6x the capacity path
+    forward / 0.8x fwd+bwd — XLA's dense batched einsum over the padded
+    (E, C, d) tensor runs at near-peak MXU rate and beats the
+    finer-grained grouped kernels despite doing 1.25x the FLOPs, so
+    ``dropless`` is the EXACTNESS option (serving, drop-sensitive
+    training), not a throughput one, at these shapes.
+
 - The Switch **load-balancing auxiliary loss** ``E * sum_e f_e * p_e``
   (fraction of tokens routed to e times mean router probability of e) is
   published through the module-state mechanism (``state["aux_loss"]``):
@@ -49,6 +62,8 @@ from jax import lax
 
 from .module import Module
 from . import init as init_lib
+
+from ..ops._pallas import ceil_to as _ceil_to
 
 __all__ = ["MoELayer"]
 
@@ -148,9 +163,14 @@ class MoELayer(Module):
             equals the full forward exactly (tests/test_moe.py).
         normalize_gates: renormalize the k selected gate values to sum to 1
             (GShard semantics); off uses raw softmax probabilities (Switch).
-        dispatch: ``"einsum"`` (GSPMD/ep-friendly dense dispatch tensors)
-            or ``"gather"`` (index-map permutation — cheaper for
-            single-device / shard_map execution); see module docstring.
+        dispatch: ``"einsum"`` (GSPMD/ep-friendly dense dispatch tensors),
+            ``"gather"`` (index-map permutation — cheaper for
+            single-device / shard_map execution), or ``"dropless"``
+            (sort-by-expert + grouped-matmul kernels, ops/gmm.py: no
+            capacity, no drops, batch-composition-independent outputs —
+            the EXACTNESS option; measured ~0.6-0.8x the capacity
+            path's speed at GPT-2-small shapes, see module docstring;
+            ``capacity_factor`` is ignored).
     """
 
     def __init__(self, dim: int, num_experts: int, hidden: int = 0,
@@ -161,9 +181,9 @@ class MoELayer(Module):
             raise ValueError(f"num_experts must be >= 2, got {num_experts}")
         if not 1 <= top_k <= num_experts:
             raise ValueError(f"top_k {top_k} not in [1, {num_experts}]")
-        if dispatch not in ("einsum", "gather"):
-            raise ValueError(f"dispatch must be 'einsum' or 'gather', "
-                             f"got {dispatch!r}")
+        if dispatch not in ("einsum", "gather", "dropless"):
+            raise ValueError(f"dispatch must be 'einsum', 'gather', or "
+                             f"'dropless', got {dispatch!r}")
         self.dim = dim
         self.num_experts = num_experts
         self.hidden = hidden or 4 * dim
@@ -216,6 +236,7 @@ class MoELayer(Module):
             gate_vals = gate_vals / jnp.maximum(
                 gate_vals.sum(-1, keepdims=True), 1e-9)
 
+
         # slot assignment: flatten the k choices in priority order (all
         # first choices, then all second choices, ...) and cumsum the
         # one-hots — each (choice, token) gets its arrival index at the
@@ -226,6 +247,18 @@ class MoELayer(Module):
         flat = oh_i.reshape(k * n, e)
         pos = (jnp.cumsum(flat, axis=0) - flat)                  # (k*N, E)
         pos = (pos * flat).sum(-1).reshape(k, n)                 # (k, N)
+
+        if self.dispatch == "dropless":
+            # pos IS each row's stable within-expert rank — the same
+            # cumsum doubles as a counting sort, so no argsort is needed
+            # (measured ~5 ms for a 16k-row argsort on v5e, dwarfing the
+            # expert matmuls themselves)
+            counts = oh_i.sum((0, 1))                            # (E,)
+            y = self._forward_dropless(p, xt, gate_vals, gate_idx, pos,
+                                       counts)
+            self._put_switch_aux(xt, probs, gate_idx)
+            return y.reshape(*lead, d)
+
         keep = (pos < c).astype(xt.dtype)                        # (k, N)
 
         if self.dispatch == "gather":
@@ -261,12 +294,79 @@ class MoELayer(Module):
         else:
             y = jnp.einsum("nec,ecd->nd", combine_t, out)
 
+        self._put_switch_aux(xt, probs, gate_idx)
+        return y.reshape(*lead, d)
+
+    def _put_switch_aux(self, xt, probs, gate_idx):
         # Switch load-balance loss on first-choice assignments
+        e = self.num_experts
         frac = jnp.mean(jax.nn.one_hot(gate_idx[:, 0], e, dtype=xt.dtype),
                         axis=0)
-        mean_prob = probs.mean(0)
-        self._put_aux(e * jnp.sum(frac * mean_prob))
-        return y.reshape(*lead, d)
+        self._put_aux(e * jnp.sum(frac * probs.mean(0)))
+
+    def _forward_dropless(self, p, xt, gate_vals, gate_idx, rank, counts):
+        """Dropless expert compute: sort the (choice, token) rows by
+        expert and run each expert over its exact segment with the
+        grouped-matmul kernels (ops/gmm.py) — MegaBlocks-style.
+
+        No capacity, no drops: every routed row is processed, and the only
+        padding is each segment's round-up to the row-block size (average
+        E*B/2 rows ≈ a few percent at LM shapes, vs the capacity path's
+        ``capacity_factor - 1`` ≈ 25% structural pad — the r4 verdict's
+        remaining MoE cost).  Batch-composition independence comes free:
+        unlike capacity slot competition, a token's output never depends
+        on the other tokens in the call.
+
+        The dispatch/combine row movements reuse the gather-path custom
+        VJPs (_dispatch_rows/_combine_rows: both directions of both
+        passes are gathers, never a data scatter); the per-expert FFN
+        matmuls and all three of their backward passes are grouped
+        matmuls over the same block→expert map (ops.gmm.grouped_linear).
+        """
+        from ..ops.gmm import grouped_linear
+
+        e, k = self.num_experts, self.top_k
+        n, d = xt.shape
+        kn = k * n
+        # row-block size: 512 rows amortizes grid/DMA overhead at LM
+        # shapes; tiny calls (tests, dryrun) shrink to keep M small
+        b = min(512, _ceil_to(max(kn // e, 1), 8))
+        m_rows = (-(-kn // b) + e) * b                 # static upper bound
+        nb = m_rows // b
+
+        # destination row per (choice, token): its expert's block-aligned
+        # segment start + its arrival rank there (``rank`` is the routing
+        # cumsum from forward() — a stable counting sort, no argsort)
+        padded = ((counts + b - 1) // b) * b
+        pad_start = jnp.cumsum(padded) - padded                 # (E,)
+        slot = (pad_start[gate_idx.T] + rank).astype(jnp.int32)  # (k, N)
+        pos = slot.reshape(-1)                                   # (k*N,)
+
+        # the two inverse maps the gather VJPs need; pad rows point at
+        # the sentinels (token n = zero row, choice k*n = dropped)
+        flat_choice = jnp.arange(kn, dtype=jnp.int32)
+        token_for_row = (jnp.full((m_rows,), n, jnp.int32)
+                         .at[pos].set(flat_choice % n))
+        choice_for_row = (jnp.full((m_rows,), kn, jnp.int32)
+                          .at[pos].set(flat_choice))
+
+        cum_padded = jnp.cumsum(padded)
+        n_live = (cum_padded[-1] // b).astype(jnp.int32)
+        # block -> expert map; overallocation-tail blocks get clamped to
+        # E-1 (tgmm needs them to extend the final segment with zero rows)
+        bg = jnp.searchsorted(cum_padded,
+                              jnp.arange(nb, dtype=jnp.int32) * b,
+                              side="right")
+        bg = jnp.minimum(bg, e - 1).astype(jnp.int32)
+        present = counts > 0
+
+        xs = _dispatch_rows(xt, token_for_row, slot)            # (M, d)
+        hdn_lin = grouped_linear(xs, p["w1"], p["b1"], bg, n_live, present,
+                                 b, 512)
+        hdn = jax.nn.gelu(hdn_lin)
+        out = grouped_linear(hdn, p["w2"], p["b2"], bg, n_live, present,
+                             b, 512)
+        return _combine_rows(out, gate_vals.T, choice_for_row, slot)
 
     def _put_aux(self, aux) -> None:
         from .module import current_context

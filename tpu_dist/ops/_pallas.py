@@ -4,7 +4,12 @@ from __future__ import annotations
 
 import jax
 
-__all__ = ["use_interpret", "out_struct"]
+__all__ = ["use_interpret", "out_struct", "ceil_to"]
+
+
+def ceil_to(x: int, m: int) -> int:
+    """Round ``x`` up to a multiple of ``m`` (tile/lane alignment)."""
+    return (x + m - 1) // m * m
 
 
 def use_interpret() -> bool:

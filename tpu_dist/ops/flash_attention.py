@@ -37,7 +37,8 @@ import math
 import jax
 import jax.numpy as jnp
 
-from ._pallas import out_struct as _out_struct, use_interpret as _use_interpret
+from ._pallas import (ceil_to as _ceil_to, out_struct as _out_struct,
+                      use_interpret as _use_interpret)
 
 __all__ = ["flash_attention", "flash_attention_with_lse"]
 
@@ -45,10 +46,6 @@ _LANE = 128
 _D_ALIGN = 64  # head_dim alignment: 64 halves K/V DMA for d=64 vs padding to 128
 _NEG_INF = -1e30  # finite: keeps max/correction arithmetic NaN-free when a
                   # whole tile is masked (same sentinel as ring_attention)
-
-
-def _ceil_to(x: int, m: int) -> int:
-    return (x + m - 1) // m * m
 
 
 def _clamp_blocks(dtype, tq, tk, block_q, block_k):
@@ -436,8 +433,11 @@ def _split_lse(q, k, v, sm_scale, block_q, block_k):
     merged with the exact blockwise-lse identity.  Executed-area ratio vs
     the single call: (n² + n/2) / (n² + n) per n = T/block — a 1/6 area
     cut at n=2, vanishing as n grows (the 8k curve point was already
-    ~90% useful); measured 2.4x fwd at 2048 same-window (the off-diag
-    tiles also shed their mask/select VPU work).
+    ~90% useful).  Measured on the v5e the area cut does NOT convert to
+    time on a quiet chip: at 2048 the single call is bound by grid-step
+    overhead (~1.9 us/step), and the split triples the step count, so it
+    only wins under heavy chip contention (1.7-2.5x there, 0.3-0.5x
+    quiet) — hence opt-in, see flash_attention_with_lse.
 
     The custom VJP is at THIS level, not composed from two _flash_lse
     VJPs: the backward recomputes p = exp(s - lse) from the MERGED lse in
@@ -546,18 +546,20 @@ def flash_attention_with_lse(q, k, v, causal: bool = False, sm_scale=None,
         x = x.reshape(-1, t, h, d)
         return jnp.swapaxes(x, 1, 2).reshape(-1, t, d)
 
-    # ``split_diag`` (None = auto): causal self-attention spanning EXACTLY
-    # two full blocks runs as the diagonal/off-diagonal two-call split
-    # (_split_lse) so executed tile area ≈ useful area.  Same-window
-    # interleaved A/B on the v5e: 2.48x fwd / 1.68x fwd+bwd at seq 2048
-    # (2 bands), but 0.5-0.8x at 4096/8192 — with 3+ bands the off-diag
-    # call's swept-but-dead grid slots (the pipeline still DMAs tiles that
-    # pl.when skips) plus the extra call overhead outweigh the shrinking
-    # masked-area saving, so the gate is n_bands == 2 exactly
+    # ``split_diag`` is OPT-IN (default off).  The two-call split
+    # (_split_lse) makes executed tile area ≈ useful area, and interleaved
+    # A/B under heavy chip contention measured it 1.7-2.5x faster at seq
+    # 2048 — but on a QUIET chip the same A/B inverts (0.3-0.5x): at 2048
+    # the single call is grid-overhead-bound, not area-bound (128 grid
+    # steps at ~1.9 us vs the split's ~384 across its finer-tiled calls),
+    # and 1024^2 single-call already runs at the same per-executed-area
+    # rate as 8k there (142 TF fwd reported / (4/3) accounting inflation
+    # ~= 107 effective ~= the 8k row).  Quiet windows are what the
+    # best-ever ratchet keeps, so the split stays a documented variant
+    # (exact numerics, tests/test_flash_attention.py), not the default.
     bq_eff, bk_eff = _clamp_blocks(q.dtype, tq, tk, block_q, block_k)
     if split_diag is None:
-        split_diag = (causal is True and tq == tk and bq_eff == bk_eff
-                      and tq == 2 * bq_eff)
+        split_diag = False
     elif split_diag:
         # explicit opt-in: the split hardcodes causal self-attention
         # semantics, so reject configurations it would silently get wrong
@@ -566,6 +568,10 @@ def flash_attention_with_lse(q, k, v, causal: bool = False, sm_scale=None,
                 "split_diag=True requires causal=True self-attention "
                 f"(tq == tk) with block_q dividing tq; got causal={causal}, "
                 f"tq={tq}, tk={tk}, effective block_q={bq_eff}")
+        # the off-diagonal predicate (k_lo + block_k <= q_lo) skips key
+        # columns outright if k tiles are coarser than the q banding —
+        # square tiles are the only layout the split supports
+        bk_eff = bq_eff
     if split_diag:
         o3, lse3 = _split_lse(to3(q, tq), to3(k, tk), to3(v, tk),
                               float(sm_scale), bq_eff, bk_eff)
