@@ -28,10 +28,14 @@ import time
 def _build_lm(max_seq_len: int, int8_weights: bool, dim: int = 768,
               depth: int = 12, heads: int = 12, vocab: int = 32768):
     """GPT-2-small-shaped TransformerLM with bf16 params; with
-    ``int8_weights``, weight-only int8 (nn/quant.py) on Linears AND
-    attention qkv/out — all matmul weights read int8 from HBM; only the
-    embedding table stays bf16 (gather traffic is one row per token,
-    negligible)."""
+    ``int8_weights``, weight-only int8 (nn/quant.py) on Linears
+    (INCLUDING the LM head — a plain nn.Linear, int8 since the r4
+    recordings) and attention qkv/out.  The embedding table stays bf16
+    ON PURPOSE: decode gathers one ~1.5 KB row per token (see
+    _per_token_read_bytes), and an interleaved A/B measured
+    ``embedding=True`` 1.38x SLOWER at batch-1 (0.328 vs 0.238 ms/token
+    — int8 table gathers lower poorly on v5e), so QuantEmbedding is a
+    model-size option, not a decode one."""
     import jax
     import jax.numpy as jnp
 
@@ -48,6 +52,29 @@ def _build_lm(max_seq_len: int, int8_weights: bool, dim: int = 768,
         lambda a: a if a.dtype == jnp.int8 else a.astype(jnp.bfloat16),
         params)
     return model, params
+
+
+def _per_token_read_bytes(model, params):
+    """Bytes of parameters actually READ per decoded token: every leaf
+    except embedding tables (a decode step gathers one ~d-sized row from
+    each, not the (V, d) table — counting the table overstated the r4
+    "implied bandwidth" figures by the table's share of bytes).
+    Returns (read_bytes, total_bytes)."""
+    import jax
+
+    from tpu_dist.nn.layers import Embedding
+    from tpu_dist.nn.quant import QuantEmbedding
+
+    embed_paths = {path for path, mod in model.named_modules()
+                   if isinstance(mod, (Embedding, QuantEmbedding))}
+    read = total = 0
+    for path, leaves in params.items():
+        for arr in jax.tree.leaves(leaves):
+            b = arr.size * arr.dtype.itemsize
+            total += b
+            if path not in embed_paths:
+                read += b
+    return read, total
 
 
 def run(batch: int = 8, prompt_len: int = 128, gen_long: int = 256,
@@ -86,8 +113,7 @@ def run(batch: int = 8, prompt_len: int = 128, gen_long: int = 256,
             b = min(b, time.perf_counter() - t0)
         return b
 
-    n_bytes = sum(p.size * p.dtype.itemsize
-                  for p in jax.tree.leaves(params))
+    n_bytes, n_bytes_total = _per_token_read_bytes(model, params)
     d_long, d_short = best(gen_long), best(gen_short)
     diff = d_long - d_short
     sec_per_tok = diff / (gen_long - gen_short)
@@ -106,9 +132,12 @@ def run(batch: int = 8, prompt_len: int = 128, gen_long: int = 256,
     tok_s = batch / sec_per_tok
 
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
-    # weights-only accounting: all param bytes once per decoded token
-    # (int8 leaves count 1 byte); KV-cache traffic is NOT included, so
-    # the implied bandwidth below is a lower bound on total HBM traffic
+    # weights-READ accounting (r5 fix): bytes a decode step actually
+    # fetches — embedding tables excluded (one gathered row per token,
+    # ~KB); the r4 rows divided TOTAL param bytes by the step time, which
+    # overstated "implied bandwidth" by the tables' share (~24% bf16 /
+    # ~31% int8 of total).  KV-cache traffic is still NOT included, so
+    # the implied bandwidth stays a lower bound on total HBM traffic.
     gb_per_tok = n_bytes / 1e9
     return {
         "metric": ("transformer_lm_decode_int8_tokens_per_sec"
@@ -120,11 +149,13 @@ def run(batch: int = 8, prompt_len: int = 128, gen_long: int = 256,
         "model": {"params_M": round(n_params / 1e6, 1), "depth": depth,
                   "dim": dim, "heads": heads, "vocab": vocab,
                   "cache_dtype": str(jnp.dtype(cache_dtype)),
-                  "weights": "int8(linear+attn)+bf16" if int8_weights
-                             else "bfloat16"},
+                  "weights": "int8(linear+head+attn)+bf16embed"
+                             if int8_weights else "bfloat16"},
         "batch": batch,
         "prompt_len": prompt_len,
         "implied_weight_read_gb_per_sec": round(gb_per_tok / sec_per_tok, 1),
+        "weight_read_mb_per_token": round(n_bytes / 1e6, 1),
+        "weight_total_mb": round(n_bytes_total / 1e6, 1),
         "gross_timing_fallback": gross,
         "n_chips": 1,
     }
@@ -150,8 +181,10 @@ def _latency(int8_weights: bool) -> dict:
 
 
 def run_latency() -> dict:
-    """Batch-1 bf16 decode latency: recorded 0.353 ms/token at ~770 GB/s
-    implied weight reads — the HBM ceiling; see run_latency_int8."""
+    """Batch-1 bf16 decode latency: recorded 0.353 ms/token = 624.7 GB/s
+    of actual weight reads (220.5 MB/token, embedding tables excluded —
+    see _per_token_read_bytes; KV-cache traffic extra); see
+    run_latency_int8."""
     return _latency(False)
 
 
@@ -240,12 +273,95 @@ def run_long_context_int8_cache(prompt_len: int = 7680, gen_long: int = 384,
     return out
 
 
+def run_prefill(batch: int = 8, prompt_len: int = 2048, reps: int = 6,
+                long_k: int = 12, short_k: int = 3) -> dict:
+    """Prefill throughput — the other half of serving (the decode rows
+    deliberately difference prefill away; r4 verdict: no prefill number
+    existed).  Times ``generate(prompt, 1)``, which is PURE prefill: one
+    causal forward populates the KV cache and the single new token is
+    sampled from the prefill logits themselves — the decode scan runs
+    zero steps at max_new_tokens=1.
+
+    Methodology: ``lax.scan`` of whole generate(n=1) calls with the
+    prompt perturbed by the carry (XLA cannot elide re-prefills),
+    long-minus-short chunks cancel dispatch+readback, min-over-reps sheds
+    contention — the standard tunnel-safe timing."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    model, params = _build_lm(prompt_len + 8, int8_weights=False)
+    rng = np.random.default_rng(0)
+    vocab = model.vocab_size
+    prompt = jnp.asarray(rng.integers(0, vocab, (batch, prompt_len)))
+
+    def chunk(n):
+        @jax.jit
+        def run_(params, prompt):
+            def body(c, _):
+                p = (prompt + c.astype(jnp.int32)) % vocab
+                out = model.generate(params, p, 1)
+                # FLOAT carry, not int: int32 `x * 0` constant-folds to 0
+                # (exact), making `out` dead and letting XLA DCE the whole
+                # generate out of the loop (measured: 12-chunk == 3-chunk
+                # wall time); f32 `x * 0` is not foldable (NaN semantics)
+                return out[0, -1].astype(jnp.float32) * 0, ()
+            c, _ = lax.scan(body, jnp.float32(0), None, length=n)
+            return c
+        return run_
+
+    run_long, run_short = chunk(long_k), chunk(short_k)
+
+    def t(f):
+        t0 = time.perf_counter()
+        float(f(params, prompt))  # host readback = the only true sync
+        return time.perf_counter() - t0
+
+    for f in (run_long, run_short):
+        t(f)
+    bl = min(t(run_long) for _ in range(reps))
+    bs = min(t(run_short) for _ in range(reps))
+    sec = (bl - bs) / (long_k - short_k)
+    gross = False
+    if sec <= 0:
+        sec, gross = bl / long_k, True
+
+    # model-FLOPs accounting for one prefill forward: 2 * matmul-param
+    # count * tokens (embedding gathers excluded) + causal attention
+    # 4 * B * T^2 * dim per layer, halved for the causal skip NOT being
+    # credited (standard flash accounting charges full T^2 — stay
+    # consistent with the attention rows)
+    n_matmul = sum(int(np.prod(p.shape))
+                   for path, leaves in params.items()
+                   if path not in ("tok", "pos")
+                   for p in jax.tree.leaves(leaves)
+                   if p.ndim >= 2)
+    depth, dim = model.depth, model.tok.embedding_dim
+    flops = (2 * n_matmul * batch * prompt_len
+             + depth * 4 * batch * prompt_len * prompt_len * dim)
+    return {
+        "metric": "transformer_lm_prefill_tokens_per_sec",
+        "value": round(batch * prompt_len / sec, 1),
+        "unit": f"tokens/sec (batch {batch}, {prompt_len}-token prompt "
+                "prefill through generate())",
+        "prefill_ms": round(sec * 1e3, 2),
+        "achieved_model_tflops": round(flops / sec / 1e12, 2),
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "gross_timing_fallback": gross,
+        "n_chips": 1,
+    }
+
+
 def run_latency_int8() -> dict:
-    """Batch-1 int8 decode latency (all matmul weights int8): the byte
-    cut converts to speed at the HBM ceiling — recorded 0.239 vs 0.353
-    ms/token (1.48x) after hoisting the per-channel scale past the
-    matmul (nn/quant.py; the pre-multiplied form measured only 1.29x
-    because XLA materialized the dequantized bf16 weight)."""
+    """Batch-1 int8 decode latency (all matmul weights int8, LM head
+    included): recorded 0.239 vs 0.353 ms/token (1.48x) after hoisting
+    the per-channel scale past the matmul (nn/quant.py; the
+    pre-multiplied form measured only 1.29x because XLA materialized the
+    dequantized bf16 weight).  Actual weight reads 110.6 MB/token =
+    462.9 GB/s — sub-ceiling, so the residual time is not weight
+    bytes (KV cache + per-layer latency); see _per_token_read_bytes."""
     return _latency(True)
 
 

@@ -152,6 +152,7 @@ def main() -> None:
         "imagenet_e2e": "resnet50_imagenet_e2e_sustained_images_per_sec",
         "vit_train": "vit_b16_imagenet_bf16_train_images_per_sec_per_chip",
         "generate": "transformer_lm_decode_tokens_per_sec",
+        "prefill": "transformer_lm_prefill_tokens_per_sec",
         "generate_int8": "transformer_lm_decode_int8_tokens_per_sec",
         "gen_latency": "transformer_lm_decode_batch1_tokens_per_sec",
         "gen_latency_int8": "transformer_lm_decode_batch1_int8_tokens_per_sec",
@@ -171,6 +172,7 @@ def main() -> None:
                      ("imagenet_e2e", imagenet_e2e.run),
                      ("vit_train", vit_train.run),
                      ("generate", generate.run),
+                     ("prefill", generate.run_prefill),
                      ("generate_int8", generate.run_int8),
                      ("gen_latency", generate.run_latency),
                      ("gen_latency_int8", generate.run_latency_int8),

@@ -117,6 +117,60 @@ def test_quantized_lm_generates(rng):
                                   np.asarray(prompt))
 
 
+def test_full_quant_topk_logit_agreement(rng):
+    """The FULLY quantized serving model — Linears (incl. the LM head),
+    attention projections, and the embedding table all int8 — keeps
+    greedy/top-k behavior: at every position the fp32 model's argmax is
+    inside the quantized model's top-5, and the top-1 agrees at >=90% of
+    positions (symmetric per-channel int8 holds logit perturbation well
+    under typical logit gaps)."""
+    model = TransformerLM(vocab_size=64, dim=32, depth=2, num_heads=4,
+                          max_seq_len=32)
+    params = model.init(jax.random.key(0))
+    toks = jnp.asarray(rng.integers(0, 64, (2, 16)))
+    full_logits = np.asarray(model.apply(params, toks))
+
+    model, qp = nn.quantize_linear_weights(model, params, attention=True,
+                                           embedding=True)
+    assert isinstance(model.tok, nn.QuantEmbedding)
+    assert isinstance(model.head, nn.QuantLinear)
+    q_logits = np.asarray(model.apply(qp, toks))
+    assert q_logits.shape == full_logits.shape
+
+    full_top1 = full_logits.argmax(-1)                       # (B, T)
+    q_top5 = np.argsort(-q_logits, axis=-1)[..., :5]
+    in_top5 = (q_top5 == full_top1[..., None]).any(-1)
+    assert in_top5.all(), f"argmax left top-5 at {np.argwhere(~in_top5)}"
+    agree = (q_logits.argmax(-1) == full_top1).mean()
+    assert agree >= 0.9, f"top-1 agreement {agree:.2f}"
+
+
+def test_quant_embedding_matches_rows(rng):
+    """QuantEmbedding gathers int8 rows + per-row scales; values track
+    the fp table within symmetric-int8 error and dtype follows scale."""
+    emb = nn.Embedding(20, 16)
+    params = emb.init(jax.random.key(0))
+    idx = jnp.asarray(rng.integers(0, 20, (4, 3)))
+    want = np.asarray(emb.apply(params, idx))
+
+    class Wrap(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.e = nn.Embedding(20, 16)
+
+        def forward(self, idx):
+            return self.e(idx)
+
+    net = Wrap()
+    p = net.init(jax.random.key(0))
+    p["e"] = dict(params[""])
+    net, qp = nn.quantize_linear_weights(net, p, embedding=True)
+    assert isinstance(net.e, nn.QuantEmbedding)
+    got = np.asarray(net.apply(qp, idx))
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, atol=np.abs(want).max() / 100)
+
+
 def test_weight_tied_linear_stays_tied(rng):
     """A Linear registered under two attributes (weight tying) must stay
     ONE module after conversion — both paths resolve to the same

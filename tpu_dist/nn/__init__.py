@@ -10,8 +10,8 @@ from .layers import (AdaptiveAvgPool2d, AvgPool2d, BatchNorm2d, Conv2d,
 from .loss import CrossEntropyLoss
 from .moe import MoELayer
 from .module import Module, Remat, Sequential, run_capturing_state
-from .quant import (QuantLinear, QuantMultiheadSelfAttention,
-                    quantize_linear_weights)
+from .quant import (QuantEmbedding, QuantLinear,
+                    QuantMultiheadSelfAttention, quantize_linear_weights)
 
 __all__ = [
     "Module", "Remat", "Sequential", "run_capturing_state",
@@ -22,6 +22,6 @@ __all__ = [
     "MultiheadSelfAttention", "scaled_dot_product_attention",
     "attention_impl", "MoELayer", "rotary_embed",
     "CrossEntropyLoss",
-    "QuantLinear", "QuantMultiheadSelfAttention",
+    "QuantEmbedding", "QuantLinear", "QuantMultiheadSelfAttention",
     "quantize_linear_weights",
 ]
