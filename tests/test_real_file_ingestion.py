@@ -1,0 +1,224 @@
+"""Real-file ingestion end-to-end — the reference's download→parse→train
+path, minus only the network.
+
+The reference examples fetch and parse the real MNIST/CIFAR archives
+(/root/reference/mpspawn_dist.py:73-74, /root/reference/example_mp.py:56-70).
+No-egress forbids real downloads, not real files: these tests generate
+BIT-EXACT-FORMAT archives at full dataset size (IDX-gzip for MNIST, the
+binary tar.gz for CIFAR-10), then exercise
+
+  - the download machinery itself over ``file://`` URLs — fetch, md5
+    verification (including the mismatch path), gunzip / tar extraction,
+    IDX / binary-record parsing; and
+  - the example training scripts end-to-end from the extracted on-disk
+    files (NO ``--synthetic``): reader → DistributedSampler → DataLoader →
+    DDP train steps in a subprocess.
+
+Archive contents are the deterministic synthetic arrays, so the few train
+steps behave like the synthetic-tier runs while the I/O path is the real
+one.
+"""
+
+import gzip
+import hashlib
+import os
+import struct
+import subprocess
+import sys
+import tarfile
+
+import numpy as np
+import pytest
+
+import tpu_dist.data.datasets as ds_mod
+from tpu_dist.data.datasets import (CIFAR10, MNIST, synthetic_cifar10_arrays,
+                                    synthetic_mnist_arrays)
+
+pytestmark = pytest.mark.slow
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _idx_bytes(arr: np.ndarray) -> bytes:
+    """Serialize an array in the IDX format (dtype 0x08 = ubyte)."""
+    arr = np.ascontiguousarray(arr, np.uint8)
+    header = struct.pack(">HBB", 0, 0x08, arr.ndim)
+    header += struct.pack(">" + "I" * arr.ndim, *arr.shape)
+    return header + arr.tobytes()
+
+
+def _md5(path: str) -> str:
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _write_mnist_mirror(mirror_dir: str):
+    """Full-size MNIST as the four .gz IDX files; returns the
+    (name, md5) resource list a torchvision-style mirror would serve."""
+    os.makedirs(mirror_dir, exist_ok=True)
+    files = []
+    for train, prefix in ((True, "train"), (False, "t10k")):
+        x, y = synthetic_mnist_arrays(train)       # (N, 28, 28, 1) uint8
+        for name, payload in (
+                (f"{prefix}-images-idx3-ubyte", _idx_bytes(x[..., 0])),
+                (f"{prefix}-labels-idx1-ubyte", _idx_bytes(y))):
+            gz_path = os.path.join(mirror_dir, name + ".gz")
+            # mtime=0: deterministic archive bytes -> stable md5
+            with open(gz_path, "wb") as f:
+                with gzip.GzipFile(fileobj=f, mode="wb", mtime=0) as gz:
+                    gz.write(payload)
+            files.append((name + ".gz", _md5(gz_path)))
+    return tuple(files)
+
+
+def _write_cifar_archive(path: str) -> str:
+    """Full-size cifar-10-binary.tar.gz (5 train batches + test batch of
+    3073-byte label+planar-RGB records); returns its md5."""
+    xtr, ytr = synthetic_cifar10_arrays(True)      # (50000, 32, 32, 3)
+    xte, yte = synthetic_cifar10_arrays(False)
+
+    def records(x, y):
+        planar = x.transpose(0, 3, 1, 2).reshape(len(x), -1)  # CHW
+        return np.concatenate(
+            [y.astype(np.uint8)[:, None], planar], axis=1).tobytes()
+
+    with tarfile.open(path, "w:gz") as tf:
+        def add(name, data):
+            import io
+            info = tarfile.TarInfo(f"cifar-10-batches-bin/{name}")
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+
+        for i in range(5):
+            add(f"data_batch_{i + 1}.bin",
+                records(xtr[i * 10000:(i + 1) * 10000],
+                        ytr[i * 10000:(i + 1) * 10000]))
+        add("test_batch.bin", records(xte, yte))
+    return _md5(path)
+
+
+@pytest.fixture(scope="module")
+def mnist_mirror(tmp_path_factory):
+    d = tmp_path_factory.mktemp("mnist_mirror")
+    return str(d), _write_mnist_mirror(str(d))
+
+
+@pytest.fixture(scope="module")
+def cifar_archive(tmp_path_factory):
+    d = tmp_path_factory.mktemp("cifar_mirror")
+    path = os.path.join(str(d), "cifar-10-binary.tar.gz")
+    return path, _write_cifar_archive(path)
+
+
+class TestDownloadMachinery:
+    def test_mnist_download_verify_gunzip_parse(self, mnist_mirror,
+                                                tmp_path, monkeypatch):
+        """MNIST(download=True) against a file:// mirror: fetch all four
+        archives, verify md5s, gunzip, parse IDX — data equals what the
+        mirror serves, bit for bit."""
+        mirror_dir, files = mnist_mirror
+        monkeypatch.setattr(ds_mod, "_MNIST_MIRROR",
+                            "file://" + mirror_dir + "/")
+        monkeypatch.setattr(ds_mod, "_MNIST_FILES", files)
+        root = str(tmp_path / "data")
+        train = MNIST(root=root, train=True, download=True)
+        x, y = synthetic_mnist_arrays(True)
+        assert train.data.shape == x.shape == (60000, 28, 28, 1)
+        np.testing.assert_array_equal(train.data, x)
+        np.testing.assert_array_equal(train.targets, y)
+        # the extracted files persist: a second constructor needs no
+        # download and reads the same bytes
+        again = MNIST(root=root, train=True)
+        np.testing.assert_array_equal(again.data, x)
+
+    def test_mnist_checksum_mismatch_rejected(self, mnist_mirror,
+                                              tmp_path, monkeypatch):
+        mirror_dir, files = mnist_mirror
+        monkeypatch.setattr(ds_mod, "_MNIST_MIRROR",
+                            "file://" + mirror_dir + "/")
+        bad = tuple((name, "0" * 32) for name, _ in files)
+        monkeypatch.setattr(ds_mod, "_MNIST_FILES", bad)
+        with pytest.raises(RuntimeError, match="checksum mismatch"):
+            MNIST(root=str(tmp_path / "data"), train=True, download=True)
+
+    def test_mnist_preplaced_gz_skips_fetch(self, mnist_mirror, tmp_path,
+                                            monkeypatch):
+        """Manually-placed .gz archives (the documented no-egress path):
+        _download gunzips without touching the mirror."""
+        mirror_dir, files = mnist_mirror
+        monkeypatch.setattr(ds_mod, "_MNIST_FILES", files)
+        monkeypatch.setattr(ds_mod, "_MNIST_MIRROR",
+                            "file:///nonexistent/")   # any fetch would fail
+        root = str(tmp_path / "data")
+        raw = os.path.join(root, "MNIST", "raw")
+        os.makedirs(raw)
+        import shutil
+        for name, _ in files:
+            shutil.copy(os.path.join(mirror_dir, name),
+                        os.path.join(raw, name))
+        test = MNIST(root=root, train=False, download=True)
+        xe, ye = synthetic_mnist_arrays(False)
+        np.testing.assert_array_equal(test.data, xe)
+        np.testing.assert_array_equal(test.targets, ye)
+
+    def test_cifar_download_verify_extract_parse(self, cifar_archive,
+                                                 tmp_path, monkeypatch):
+        """CIFAR10(download=True) over file://: fetch the tar.gz, verify
+        md5, extract, parse the 3073-byte records into NHWC."""
+        path, md5 = cifar_archive
+        monkeypatch.setattr(ds_mod, "_CIFAR10_URL", "file://" + path)
+        monkeypatch.setattr(ds_mod, "_CIFAR10_MD5", md5)
+        root = str(tmp_path / "data")
+        train = CIFAR10(root=root, train=True, download=True)
+        xtr, ytr = synthetic_cifar10_arrays(True)
+        assert train.data.shape == (50000, 32, 32, 3)
+        np.testing.assert_array_equal(train.data, xtr)
+        np.testing.assert_array_equal(train.targets, ytr)
+        test = CIFAR10(root=root, train=False)
+        assert test.data.shape == (10000, 32, 32, 3)
+
+
+class TestExamplesFromRealFiles:
+    """The reference flow end-to-end: on-disk archives → extract → example
+    training scripts (no synthetic fallback anywhere)."""
+
+    def _run(self, script, extra, cwd):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+        r = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "examples", script)]
+            + extra, env=env, capture_output=True, text=True, timeout=600,
+            cwd=cwd)
+        assert r.returncode == 0, f"{script} failed:\n{r.stdout[-2000:]}\n" \
+                                  f"{r.stderr[-4000:]}"
+        return r
+
+    def test_mpspawn_mnist_trains_from_idx_files(self, mnist_mirror,
+                                                 tmp_path, monkeypatch):
+        mirror_dir, files = mnist_mirror
+        monkeypatch.setattr(ds_mod, "_MNIST_MIRROR",
+                            "file://" + mirror_dir + "/")
+        monkeypatch.setattr(ds_mod, "_MNIST_FILES", files)
+        root = str(tmp_path / "data")
+        MNIST(root=root, train=True, download=True)   # extract train set
+        MNIST(root=root, train=False, download=True)  # + test set
+        r = self._run("mpspawn_dist.py",
+                      ["--backend", "cpu", "--epochs", "1", "--max-steps",
+                       "3", "--batch-size", "100", "--data-root", root,
+                       "--evaluate"], cwd=str(tmp_path))
+        assert "Load data....done!" in r.stdout
+
+    def test_example_mp_trains_from_cifar_binaries(self, cifar_archive,
+                                                   tmp_path, monkeypatch):
+        path, md5 = cifar_archive
+        monkeypatch.setattr(ds_mod, "_CIFAR10_URL", "file://" + path)
+        monkeypatch.setattr(ds_mod, "_CIFAR10_MD5", md5)
+        root = str(tmp_path / "data")
+        CIFAR10(root=root, train=True, download=True)  # extract batches
+        self._run("example_mp.py",
+                  ["--backend", "cpu", "--epochs", "1", "--max-steps", "3",
+                   "--batch-size", "32", "--data-root", root],
+                  cwd=str(tmp_path))

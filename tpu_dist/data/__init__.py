@@ -10,8 +10,9 @@ from . import transforms
 from .datasets import (ArrayImageDataset, CIFAR10, ConcatDataset, Dataset,
                        ImageFolder, MNIST, Subset, SyntheticImageNet,
                        TensorDataset, random_split,
-                       synthetic_cifar10_arrays, synthetic_mnist_arrays,
-                       synthetic_mnist_noisy_arrays)
+                       synthetic_cifar10_arrays,
+                       synthetic_cifar10_noisy_arrays,
+                       synthetic_mnist_arrays, synthetic_mnist_noisy_arrays)
 from .device_augment import DeviceAugment, bilinear_crop_resize
 from .loader import DataLoader, DeviceLoader, default_collate
 from .sampler import (BatchSampler, DistributedSampler, RandomSampler,
@@ -24,7 +25,7 @@ __all__ = [
     "ImageFolder", "SyntheticImageNet",
     "Subset", "ConcatDataset", "random_split",
     "synthetic_mnist_arrays", "synthetic_cifar10_arrays",
-    "synthetic_mnist_noisy_arrays",
+    "synthetic_mnist_noisy_arrays", "synthetic_cifar10_noisy_arrays",
     "DataLoader", "DeviceLoader", "default_collate",
     "DeviceAugment", "bilinear_crop_resize",
     "Sampler", "SequentialSampler", "RandomSampler", "BatchSampler",

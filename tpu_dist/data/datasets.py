@@ -275,6 +275,29 @@ def synthetic_mnist_noisy_arrays(train: bool, n: Optional[int] = None,
     return x, y
 
 
+def synthetic_cifar10_noisy_arrays(train: bool, n: Optional[int] = None,
+                                   label_noise: float = 0.25):
+    """The CIFAR-shaped low-SNR oracle — same construction as
+    :func:`synthetic_mnist_noisy_arrays` (uniform label flips with
+    probability ``label_noise``, analytic test-accuracy ceiling
+    ``(1 - rho) + rho/10 = 0.775``), over the CIFAR class templates.
+
+    This is the discriminative oracle for the ResNet/BatchNorm/
+    augmentation pipeline (r4 verdict #9): the clean CIFAR synthetic set
+    saturates at 0.9999 through ``example_mp.py``'s recipe and cannot
+    catch subtle breakage; a correct run of the SAME recipe on this set
+    must land within ±3 binomial SE of 0.775 (asserted in
+    tests/test_accuracy_oracle.py; chip recording in ACCURACY.json
+    ``cifar_resnet_low_snr_oracle``)."""
+    if n is None:
+        n = 50000 if train else 10000
+    x, y = _synthetic_arrays(n, (32, 32), 3, 10, (0xDA7A, 1), int(train))
+    rng = np.random.default_rng((0xDA7A, 3, int(train)))
+    flip = rng.random(n) < label_noise
+    y = np.where(flip, rng.integers(0, 10, n), y).astype(np.int64)
+    return x, y
+
+
 # ---------------------------------------------------------------------------
 # download machinery (reference parity: torchvision download=True)
 # ---------------------------------------------------------------------------
@@ -449,7 +472,14 @@ class CIFAR10(ArrayImageDataset):
         if not os.path.exists(archive):
             _download_file(_CIFAR10_URL, archive, _CIFAR10_MD5)
         with tarfile.open(archive, "r:gz") as tf:
-            tf.extractall(root)
+            # filter="data" rejects path traversal / special members
+            # (also the Python 3.14 default; silences the 3.12 warning);
+            # the kwarg only exists on 3.10.12+/3.11.4+/3.12+, so fall
+            # back for older supported interpreters
+            try:
+                tf.extractall(root, filter="data")
+            except TypeError:
+                tf.extractall(root)
 
 
 class ImageFolder(Dataset):
