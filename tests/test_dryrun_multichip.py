@@ -1,0 +1,41 @@
+"""Pin the 16/32-device dryrun claims as reproducible-from-repo.
+
+The driver's contract runs ``__graft_entry__.dryrun_multichip(8)``; rounds
+3-4 additionally claimed green runs at 16 and 32 devices in commit
+messages only (r4 verdict #6: not recorded as an artifact).  This test
+invokes the real child re-exec path (a subprocess with an n-device
+virtual CPU mesh forced before JAX initializes) at both sizes, and
+``MULTICHIP_EXTENDED.json`` records the same runs as a committed
+artifact (regenerate: ``python -m tests.gen_multichip_extended``).
+
+Each size compiles and executes one train step per mesh config (dp,
+dp x sp ring/flash, dp x tp + TP decode, dp x pp, dp x ep, fsdp, and the
+3-D dp x fsdp x tp) — several minutes of CPU compile work, hence slow
+tier.
+"""
+
+import importlib.util
+import os
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_graft_entry():
+    spec = importlib.util.spec_from_file_location(
+        "__graft_entry__", os.path.join(_REPO, "__graft_entry__.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.parametrize("n_devices", [16, 32])
+def test_dryrun_multichip_large_worlds(n_devices):
+    g = _load_graft_entry()
+    # the calling process holds an 8-device mesh (conftest) — fewer than
+    # requested, so this exercises the child re-exec path exactly as the
+    # driver would on a 1-chip host
+    g.dryrun_multichip(n_devices)
