@@ -155,6 +155,48 @@ def test_pp_remat_matches_single_device(eight_devices):
         np.asarray(a), np.asarray(b), atol=2e-5), got, ref_params)
 
 
+@pytest.mark.parametrize("mesh,mb", [
+    (("pipe",), (8,)), (("data", "pipe"), (2, 4))])
+def test_1f1b_matches_gpipe_and_single_device(eight_devices, mesh, mb):
+    """schedule="1f1b" (hand-interleaved fwd/bwd scan with the
+    min(2S-1, M)-slot input ring) is the same math as GPipe: identical
+    loss and, with SGD lr=1 making param deltas equal gradients,
+    identical gradients to float tolerance — and both match the plain
+    single-device oracle."""
+    dist.init_process_group(backend="cpu", axis_names=mesh, mesh_shape=mb)
+    model = _model()
+    x, y = _data(16)
+    if len(mesh) == 2:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        sh = NamedSharding(dist.get_default_group().mesh, P("data"))
+        x, y = jax.device_put(x, sh), jax.device_put(y, sh)
+
+    results = {}
+    for sched in ("gpipe", "1f1b"):
+        pipe = PipelineParallel(model, optimizer=optim.SGD(lr=1.0),
+                                loss_fn=nn.CrossEntropyLoss(),
+                                num_microbatches=8, schedule=sched,
+                                donate=False)
+        state = pipe.init(seed=0)
+        new_state, metrics = pipe.train_step(state, x, y)
+        results[sched] = (pipe.unpack_params(
+            jax.device_get(new_state.params)), float(metrics["loss"]))
+
+    (p_g, l_g), (p_1, l_1) = results["gpipe"], results["1f1b"]
+    assert l_g == pytest.approx(l_1, abs=1e-6)
+    for a, b in zip(jax.tree.leaves(p_g), jax.tree.leaves(p_1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-6, rtol=2e-6)
+    ref_params, ref_loss = _reference_step(
+        model, model.init(jax.random.key(0)), optim.SGD(lr=1.0), x, y)
+    assert l_1 == pytest.approx(float(ref_loss), abs=1e-5)
+    for (k, a) in ref_params.items():
+        for n, v in a.items():
+            np.testing.assert_allclose(
+                np.asarray(p_1[k][n]), np.asarray(v), atol=1e-4, rtol=1e-4,
+                err_msg=f"{k}.{n}")
+
+
 def test_pack_unpack_roundtrip(eight_devices):
     dist.init_process_group(backend="cpu", axis_names=("pipe",))
     model = _model()
