@@ -63,6 +63,8 @@ def _better(new: dict, old: dict) -> dict:
         for extra_key in ("throughput_scaling", "reference_batch_recording",
                           "linear_only_recording", "remat_on_recording",
                           "speedup_vs_bf16_batch1",
+                          "int8_embedding_table_ab", "accounting_note",
+                          "weight_read_mb_per_token", "weight_total_mb",
                           "same_window_vs_dense_lm"):
             if extra_key not in best:
                 loser = old if best is new else new
