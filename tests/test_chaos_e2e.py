@@ -1,0 +1,204 @@
+"""Chaos end-to-end: supervised restart with auto-resume, and the heartbeat
+watchdog on a hung (alive-but-silent) rank — the ISSUE 1 acceptance runs.
+
+Real OS processes on the CPU backend with tight deadlines; deliberately
+tier-1 (``chaos`` marker, NOT ``slow``): the elastic layer must be proven on
+every PR, not only in the nightly slow tier.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+pytestmark = [pytest.mark.chaos, pytest.mark.multiprocess]
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# ConvNet trained on synthetic data keyed ONLY on (rank, step): any two runs
+# — interrupted or not — see identical batches at identical steps, so loss
+# trajectories and final parameters must agree bit-for-bit.  Grad averaging
+# rides the store-transport gather/scatter collectives (a real cross-process
+# sync every step; XLA multiprocess computations don't exist on this CPU
+# backend, which is also why the workers block on a dead peer — exactly the
+# hang the resilience layer must break).
+_TRAIN_WORKER = textwrap.dedent("""
+    import hashlib, json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import tpu_dist.dist as dist
+    from tpu_dist import collectives as C
+    from tpu_dist import optim, resilience
+    from tpu_dist.models import ConvNet
+    from tpu_dist.nn import functional as F
+
+    out_dir, ckpt_root, n_steps = sys.argv[1], sys.argv[2], int(sys.argv[3])
+    pg = dist.init_process_group(backend="cpu", init_method="env://")
+    rank, nproc = dist.get_rank(), dist.get_num_processes()
+
+    model = ConvNet()
+    params0 = model.init(jax.random.PRNGKey(0))
+    opt = optim.SGD(lr=0.05, momentum=0.9)
+
+    def batch(step, r):
+        g = np.random.default_rng(10_000 * (r + 1) + step)
+        x = g.standard_normal((8, 28, 28, 1)).astype(np.float32)
+        y = g.integers(0, 10, size=(8,)).astype(np.int32)
+        return x, y
+
+    @jax.jit
+    def fwd_bwd(params, x, y):
+        def loss(p):
+            return F.cross_entropy(model.apply(p, x), y)
+        return jax.value_and_grad(loss)(params)
+
+    losses = {}
+    with resilience.TrainState(ckpt_root, save_every=5, keep=None) as ts:
+        state, start = ts.resume({"params": params0,
+                                  "opt": opt.init(params0)})
+        params, opt_state = state["params"], state["opt"]
+        for step in range(start, n_steps):
+            x, y = batch(step, rank)
+            l, g = fwd_bwd(params, x, y)
+            g = jax.tree.map(np.asarray, g)
+            gathered = C.gather_host(g, dst=0, group=pg)
+            if rank == 0:
+                avg = jax.tree.map(
+                    lambda *xs: (np.sum(xs, axis=0) / nproc)
+                    .astype(np.float32), *gathered)
+                g = C.scatter_host(g, [avg] * nproc, src=0, group=pg)
+            else:
+                g = C.scatter_host(g, None, src=0, group=pg)
+            params, opt_state = opt.update(g, opt_state, params)
+            losses[step] = float(l)
+            ts.end_step({"params": params, "opt": opt_state}, step)
+
+    leaves = [np.asarray(a, np.float32).ravel()
+              for a in jax.tree_util.tree_leaves(params)]
+    digest = hashlib.sha256(np.concatenate(leaves).tobytes()).hexdigest()
+    with open(os.path.join(out_dir, f"final{rank}.json"), "w") as f:
+        json.dump({"rank": rank, "start": start,
+                   "generation": dist.generation(),
+                   "losses": {str(k): v for k, v in losses.items()},
+                   "params_sha256": digest}, f)
+    dist.destroy_process_group()
+""")
+
+
+def _launch_train(tmp_path, tag, chaos=None, max_restarts=0, n_steps=10,
+                  timeout=420):
+    out_dir = tmp_path / tag
+    out_dir.mkdir()
+    script = tmp_path / "train_worker.py"
+    script.write_text(_TRAIN_WORKER)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # 4 virtual devices per process, the known-good CPU multiprocess
+    # topology (test_multiprocess_e2e.py): 1 device per process trips
+    # "Multiprocess computations aren't implemented on the CPU backend"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    if chaos is not None:
+        env["TPU_DIST_CHAOS"] = chaos
+    else:
+        env.pop("TPU_DIST_CHAOS", None)
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_dist.launch", "--nproc_per_node=2",
+         "--master_port=0", f"--max_restarts={max_restarts}",
+         "--restart_backoff=0.1", "--heartbeat_timeout=3",
+         str(script), str(out_dir), str(out_dir / "ckpt"), str(n_steps)],
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=timeout)
+    return r, out_dir
+
+
+def _finals(out_dir, nproc=2):
+    out = {}
+    for rank in range(nproc):
+        with open(out_dir / f"final{rank}.json") as f:
+            out[rank] = json.load(f)
+    return out
+
+
+def test_kill_at_step5_restart_resume_bitwise(tmp_path):
+    """THE acceptance run: SIGKILL rank 1 at step 5 of a 2-process ConvNet
+    job → the supervisor detects, restarts the gang (next generation),
+    both ranks resume from the step-5 checkpoint, and the final loss
+    trajectory + parameters match an uninterrupted run bit-for-bit."""
+    ra, dir_a = _launch_train(tmp_path, "interrupted",
+                              chaos="kill:rank=1,step=5", max_restarts=1)
+    assert ra.returncode == 0, f"stdout:\n{ra.stdout}\nstderr:\n{ra.stderr}"
+    assert "relaunching" in ra.stderr  # a restart actually happened
+
+    rb, dir_b = _launch_train(tmp_path, "clean")
+    assert rb.returncode == 0, f"stdout:\n{rb.stdout}\nstderr:\n{rb.stderr}"
+
+    fa, fb = _finals(dir_a), _finals(dir_b)
+    for rank in (0, 1):
+        # interrupted run finished inside the restarted generation, having
+        # resumed from the step-5 checkpoint (start == 6)
+        assert fa[rank]["generation"] == 1, fa[rank]
+        assert fa[rank]["start"] == 6, fa[rank]
+        assert fb[rank]["generation"] == 0 and fb[rank]["start"] == 0
+        # post-resume losses identical to the uninterrupted run, bitwise
+        for step in range(6, 10):
+            assert fa[rank]["losses"][str(step)] == \
+                fb[rank]["losses"][str(step)], f"step {step} diverged"
+    # final parameters identical across ranks and across runs
+    digests = {f["params_sha256"] for f in (*fa.values(), *fb.values())}
+    assert len(digests) == 1, f"parameter divergence: {digests}"
+
+
+def test_kill_with_max_restarts_zero_stays_fail_fast(tmp_path):
+    """--max_restarts=0 preserves today's semantics exactly: the injected
+    failure kills the world, nothing restarts, nothing resumes."""
+    r, out_dir = _launch_train(tmp_path, "failfast",
+                               chaos="kill:rank=1,step=5", max_restarts=0)
+    assert r.returncode != 0
+    assert "relaunching" not in r.stderr
+    assert not (out_dir / "final0.json").exists()
+    assert not (out_dir / "final1.json").exists()
+
+
+# Hung-rank worker: publishes heartbeats, then rank 1's beat is stalled by
+# chaos while the process stays alive (the hung-collective shape).  No
+# jax.distributed here — the launcher's watchdog is the system under test,
+# and a plain sleep cannot mask a SIGTERM the way a gRPC wait can.
+_HUNG_WORKER = textwrap.dedent("""
+    import os, sys, time
+    from tpu_dist import resilience
+
+    resilience.install_chaos_from_env()
+    hb = resilience.Heartbeat(interval=0.2).start()
+    assert hb.enabled, "launcher must provide TPU_DIST_STORE_ADDR"
+    for step in range(4):
+        hb.set_step(step)   # chaos stalls rank 1's beat from step 2 on
+        time.sleep(0.1)
+    time.sleep(600)         # both ranks stay ALIVE (rank 0 keeps beating)
+""")
+
+
+def test_hung_rank_named_rank_lost_within_deadline(tmp_path):
+    """A rank whose heartbeat stalls while its process stays alive must be
+    diagnosed as a named RankLostError within --heartbeat_timeout — not
+    hang until some multi-minute collective timeout."""
+    script = tmp_path / "hung_worker.py"
+    script.write_text(_HUNG_WORKER)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["TPU_DIST_CHAOS"] = "stall-heartbeat:rank=1,step=2"
+    t0 = time.monotonic()
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_dist.launch", "--nproc_per_node=2",
+         "--master_port=0", "--heartbeat_timeout=3", str(script)],
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=180)
+    elapsed = time.monotonic() - t0
+    assert r.returncode != 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    assert "RankLostError" in r.stderr, r.stderr
+    assert "rank 1" in r.stderr, r.stderr
+    assert elapsed < 90, f"hung-rank diagnosis took {elapsed:.0f}s"

@@ -166,6 +166,69 @@ class TestInterop:
             _load_native.reset()
 
 
+class TestReconnect:
+    """Partition behavior of the pure-Python client: bounded
+    reconnect-with-backoff for idempotent ops, at-most-once for writes,
+    and a named ConnectionError (not a hang) when the server is gone."""
+
+    @pytest.fixture
+    def py_store(self, monkeypatch):
+        monkeypatch.setenv("TPU_DIST_PURE_PYTHON_STORE", "1")
+        _load_native.reset()
+        s = TCPStore(is_master=True)
+        yield s
+        s.close()
+        _load_native.reset()
+
+    def test_get_survives_dropped_connection(self, py_store):
+        py_store.set("k", b"v")
+        py_store._client._sock.close()  # simulated ECONNRESET
+        assert py_store.get("k") == b"v"  # transparent reconnect + replay
+
+    def test_check_survives_dropped_connection(self, py_store):
+        py_store.set("k", b"v")
+        py_store._client._sock.close()
+        assert py_store.check("k")
+
+    def test_set_not_replayed_but_connection_recovers(self, py_store):
+        py_store._client._sock.close()
+        with pytest.raises(ConnectionError):
+            py_store.set("k", b"v1")  # at-most-once: surfaced, not resent
+        py_store.set("k", b"v2")      # fresh socket for the next request
+        assert py_store.get("k") == b"v2"
+
+    def test_add_not_replayed(self, py_store):
+        # a replayed ADD could double-count a barrier arrival — must raise
+        py_store._client._sock.close()
+        with pytest.raises(ConnectionError):
+            py_store.add("ctr", 1)
+
+    def test_server_death_mid_wait_raises_not_hangs(self, py_store):
+        client = TCPStore(host=py_store.host, port=py_store.port)
+        errs = []
+
+        def waiter():
+            try:
+                client.wait_value_ge("never", 5)  # server-side blocking wait
+            except Exception as e:
+                errs.append(e)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.2)  # ensure the waiter is blocked server-side
+        py_store.close()  # server dies mid-wait
+        t.join(timeout=30)
+        assert not t.is_alive(), "client hung after server death"
+        # RuntimeError: server answered status!=0 while stopping;
+        # ConnectionError: connection dropped and reconnects exhausted
+        assert errs and isinstance(errs[0], (ConnectionError, RuntimeError))
+        client.close()
+
+    def test_wait_deadline_expiry_names_key(self, py_store):
+        with pytest.raises(TimeoutError, match="missing-key"):
+            py_store.wait(["missing-key"], timeout=0.2)
+
+
 class TestFileStore:
     def test_roundtrip(self, tmp_path):
         s = FileStore(str(tmp_path / "store"))

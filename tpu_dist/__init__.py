@@ -16,6 +16,7 @@ TPU.  Currently shipped subpackages:
 - ``tpu_dist.parallel`` — DDP, GSPMD tensor parallel, GPipe pipeline,
   ring/Ulysses sequence parallel, MoE expert-parallel rules
 - ``tpu_dist.checkpoint`` — atomic step-numbered save/restore (sharded ok)
+- ``tpu_dist.resilience`` — heartbeat watchdog, auto-resume, chaos faults
 - ``tpu_dist.utils`` — rank-0 logging, metric windows, profiling
 - ``tpu_dist.ops`` — Pallas TPU kernels (fused CE, flash attention)
 """
@@ -23,7 +24,8 @@ TPU.  Currently shipped subpackages:
 __version__ = "0.1.0"
 
 from . import (checkpoint, collectives, data, dist, interop, models, nn,
-               optim, parallel, utils)
+               optim, parallel, resilience, utils)
 
 __all__ = ["nn", "optim", "models", "dist", "collectives", "data",
-           "parallel", "checkpoint", "utils", "interop", "__version__"]
+           "parallel", "checkpoint", "resilience", "utils", "interop",
+           "__version__"]

@@ -105,27 +105,57 @@ def save(root: str, tree: Any, step: int, metadata: Optional[Dict] = None,
     return path
 
 
+def _fsync_path(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _sha256_file(path: str) -> str:
+    import hashlib
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
 def _write(root: str, path: str, arrays: Dict[str, np.ndarray], step: int,
            metadata: Optional[Dict], keep: Optional[int]) -> None:
     """Serialize already-host-side arrays to ``path`` (atomic tmp+rename),
     then prune to the newest ``keep`` step dirs.  Pure host I/O — safe to
-    run off-thread (the AsyncCheckpointer's worker)."""
+    run off-thread (the AsyncCheckpointer's worker).
+
+    Durability: both files and the tmp dir are fsync'd before the rename,
+    and the parent dir after — without that, a host crash can surface a
+    "committed" (renamed) checkpoint whose data blocks never hit disk,
+    i.e. a truncated arrays.npz behind a valid-looking directory.  The
+    npz's sha256 rides in tree.json so :func:`restore` can verify."""
     os.makedirs(root, exist_ok=True)
     tmp = tempfile.mkdtemp(dir=root, prefix=".tmp_ckpt_")
     try:
-        np.savez(os.path.join(tmp, "arrays.npz"), **arrays)
+        npz_path = os.path.join(tmp, "arrays.npz")
+        np.savez(npz_path, **arrays)
         meta = {
             "step": step,
             "leaves": {k: {"shape": list(a.shape), "dtype": str(a.dtype)}
                        for k, a in arrays.items()},
             "metadata": metadata or {},
+            "arrays_sha256": _sha256_file(npz_path),
             "format_version": 1,
         }
         with open(os.path.join(tmp, "tree.json"), "w") as f:
             json.dump(meta, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        _fsync_path(npz_path)
+        _fsync_path(tmp)
         if os.path.exists(path):
             shutil.rmtree(path)
         os.rename(tmp, path)
+        _fsync_path(root)  # persist the rename itself
     except BaseException:
         shutil.rmtree(tmp, ignore_errors=True)
         raise
@@ -246,13 +276,16 @@ def latest_step(root: str) -> Optional[int]:
 
 
 def restore(root: str, template: Any, step: Optional[int] = None,
-            sharding=None) -> Any:
+            sharding=None, verify: bool = False) -> Any:
     """Load a checkpoint into the structure of ``template``.
 
     ``step=None`` loads the latest.  ``sharding`` controls device placement:
     a single ``jax.sharding.Sharding`` applies to every leaf; a pytree
     matching ``template``'s structure gives per-leaf placement.  Default
-    leaves arrays on host for the caller to place.
+    leaves arrays on host for the caller to place.  ``verify=True``
+    recomputes ``arrays.npz``'s sha256 against the digest recorded at save
+    time before deserializing — the load-time check for a checkpoint
+    corrupted after commit (bit rot, partial copy, crash without fsync).
 
     Raises with a precise message when the tree structure or a leaf
     shape/dtype does not match the template — resuming into a changed model
@@ -267,7 +300,20 @@ def restore(root: str, template: Any, step: Optional[int] = None,
     path = os.path.join(root, f"step_{step:08d}")
     with open(os.path.join(path, "tree.json")) as f:
         meta = json.load(f)
-    with np.load(os.path.join(path, "arrays.npz")) as npz:
+    npz_path = os.path.join(path, "arrays.npz")
+    if verify:
+        recorded = meta.get("arrays_sha256")
+        if recorded is None:
+            raise ValueError(
+                f"checkpoint at {path!r} records no arrays digest (written "
+                f"by an older tpu_dist); re-save it or pass verify=False")
+        actual = _sha256_file(npz_path)
+        if actual != recorded:
+            raise ValueError(
+                f"checkpoint at {path!r} failed digest verification "
+                f"(recorded sha256 {recorded[:12]}…, actual {actual[:12]}…) "
+                f"— truncated or corrupted; refusing to load")
+    with np.load(npz_path) as npz:
         arrays = {k: npz[k] for k in npz.files}
 
     flat_t = _flatten(template)

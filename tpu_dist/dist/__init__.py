@@ -12,7 +12,7 @@ from .process_group import (DATA_AXIS, ProcessGroup, abort, barrier,
                             get_local_world_size, get_num_processes,
                             get_rank, get_world_size, init_process_group,
                             is_initialized, new_group)
-from .rendezvous import parse_init_method, rendezvous
+from .rendezvous import generation, get_store, parse_init_method, rendezvous
 from .store import Store, TCPStore, FileStore
 from ..collectives.eager import ReduceOp  # torch `dist.ReduceOp` parity
 
@@ -22,6 +22,6 @@ __all__ = [
     "get_backend",
     "get_local_rank", "get_local_world_size", "get_num_processes",
     "new_group", "barrier", "monitored_barrier", "abort", "DATA_AXIS",
-    "rendezvous", "parse_init_method",
+    "rendezvous", "parse_init_method", "generation", "get_store",
     "Store", "TCPStore", "FileStore", "ReduceOp",
 ]

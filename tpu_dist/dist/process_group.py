@@ -352,7 +352,7 @@ def monitored_barrier(group: Optional[ProcessGroup] = None,
                          "non-member ranks as missing)")
     if g.num_processes <= 1:
         return
-    store = _rdzv._store
+    store = _rdzv.get_store()
     if store is None:
         raise RuntimeError(
             "monitored_barrier needs the control-plane store (launcher or "

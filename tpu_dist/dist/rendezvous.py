@@ -44,11 +44,57 @@ import warnings
 from typing import Optional, Tuple
 from urllib.parse import urlparse
 
-__all__ = ["rendezvous", "shutdown", "parse_init_method"]
+__all__ = ["rendezvous", "shutdown", "parse_init_method", "generation",
+           "get_store"]
 
 _distributed_started = False
 _store = None            # control-plane TCPStore client (see module docstring)
 _store_num_processes = 0
+
+# Store key holding the gang's current incarnation number (the supervisor
+# bumps it before every restart round); see _fence_generation.
+GENERATION_KEY = "tpu_dist/generation"
+
+
+def generation() -> int:
+    """This process's gang incarnation (``TPU_DIST_RESTART_COUNT``, set by
+    the launch CLI's supervisor loop / ``spawn(max_restarts=...)``; 0 on a
+    fresh launch or outside any launcher)."""
+    try:
+        return int(os.environ.get("TPU_DIST_RESTART_COUNT", "0") or 0)
+    except ValueError:
+        return 0
+
+
+def get_store():
+    """The control-plane store client (None before :func:`rendezvous`, or
+    when the job runs without a store)."""
+    return _store
+
+
+def _fence_generation(store, process_id: int) -> None:
+    """Reject a rank from a previous gang incarnation.
+
+    The supervisor publishes the current generation to the store before
+    (re)spawning a round; a process whose ``TPU_DIST_RESTART_COUNT`` is
+    older was left over from an incarnation that already failed (e.g. it
+    was hung in a collective while the gang restarted around it) and must
+    not write liveness keys or join the new rendezvous.  One-directional:
+    a store generation *behind* this rank's just means the supervisor has
+    not published yet (spawn/publish ordering), which is harmless."""
+    gen = generation()
+    try:
+        if not store.check(GENERATION_KEY):
+            return
+        current = int(store.get(GENERATION_KEY))
+    except Exception:
+        return  # store trouble degrades diagnostics, not correctness
+    if current > gen:
+        raise RuntimeError(
+            f"rank {process_id} fenced out: it belongs to gang generation "
+            f"{gen} but the supervisor has moved on to generation {current} "
+            f"(the gang restarted while this process was stalled); exiting "
+            f"instead of corrupting the new incarnation's rendezvous")
 
 
 def parse_init_method(init_method: Optional[str],
@@ -175,8 +221,19 @@ def rendezvous(init_method: Optional[str], world_size: int = -1,
     service.  Safe to call once per process.
     """
     global _distributed_started
+    chaos_active = None
+    if os.environ.get("TPU_DIST_CHAOS"):
+        # deterministic fault injection rides along with any worker, no
+        # code changes needed (tpu_dist/resilience/chaos.py)
+        from ..resilience import chaos as _chaos
+        chaos_active = _chaos.install_from_env()
     coordinator, num_processes, process_id = parse_init_method(
         init_method, world_size, rank)
+    if chaos_active is not None:
+        # install_from_env could only guess from the RANK env var; the
+        # resolved process_id is authoritative (mp.spawn and explicit
+        # tcp:// ranks never set RANK)
+        chaos_active.rank = process_id
     if coordinator is None or num_processes <= 1:
         return
 
@@ -196,6 +253,7 @@ def rendezvous(init_method: Optional[str], world_size: int = -1,
                       f"without liveness/pre-flight diagnostics")
         store = None
     if store is not None:
+        _fence_generation(store, process_id)
         _preflight(store, num_processes, process_id, timeout)
     # NOTE: must not touch any backend-initializing JAX API here
     # (jax.devices()/process_count()): jax.distributed.initialize has to run

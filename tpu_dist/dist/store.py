@@ -213,38 +213,99 @@ class PyTCPStoreServer:
             pass
 
 
+# Chaos fault hook (tpu_dist.resilience.chaos): called as fn(client, op, key)
+# at the top of every _PyClient request; may close the client socket or sleep
+# to inject deterministic connection faults.  None in production.
+FAULT_HOOK = None
+
+# Reads (and the server-side blocking wait) are safe to replay after a lost
+# connection; SET/ADD/DELETE are NOT — the server may have applied the op
+# before the connection died, and a blind resend would double-apply (fatal
+# for ADD-based barrier generations).  Those stay at-most-once.
+_IDEMPOTENT_OPS = frozenset({_OP_GET, _OP_CHECK, _OP_NUMKEYS, _OP_WAIT_GE})
+_RECONNECT_ATTEMPTS = 4
+_RECONNECT_BACKOFF = 0.05  # doubles per attempt
+
+
 class _PyClient:
-    """Pure-Python client for the store wire protocol."""
+    """Pure-Python client for the store wire protocol.
+
+    A dropped connection (ECONNRESET, server restart, injected fault)
+    mid-request is retried with bounded reconnect-and-backoff for
+    idempotent ops (GET/CHECK/NUMKEYS/WAIT_GE) and surfaces as
+    ``ConnectionError`` for the at-most-once ops (SET/ADD/DELETE)."""
 
     def __init__(self, host: str, port: int, timeout: float):
+        self._host, self._port = host, port
+        self._sock = self._connect(host, port, timeout)
+        self._mu = threading.Lock()
+
+    @staticmethod
+    def _connect(host: str, port: int, timeout: float):
         deadline = time.monotonic() + timeout
-        last_err = None
         while True:
             try:
-                self._sock = socket.create_connection((host, port), timeout=5)
+                sock = socket.create_connection((host, port), timeout=5)
                 break
             except OSError as e:
-                last_err = e
                 if time.monotonic() > deadline:
                     raise TimeoutError(
                         f"could not connect to store at {host}:{port}: {e}")
                 time.sleep(0.05)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._sock.settimeout(None)  # GET/WAIT_GE block indefinitely
-        self._mu = threading.Lock()
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.settimeout(None)  # GET/WAIT_GE block indefinitely
+        return sock
 
     def request(self, op: int, key: str, payload: bytes = b"") -> bytes:
+        if FAULT_HOOK is not None:
+            FAULT_HOOK(self, op, key)  # once per logical request, not retry
         kb = key.encode()
         msg = (struct.pack("<BI", op, len(kb)) + kb
                + struct.pack("<I", len(payload)) + payload)
         with self._mu:
-            self._sock.sendall(msg)
-            hdr = PyTCPStoreServer._recv_all(self._sock, 8)
-            if hdr is None:
-                raise ConnectionError("store connection closed")
-            status, dlen = struct.unpack("<II", hdr)
-            data = (PyTCPStoreServer._recv_all(self._sock, dlen)
-                    if dlen else b"")
+            attempt = 0
+            while True:
+                try:
+                    self._sock.sendall(msg)
+                    hdr = PyTCPStoreServer._recv_all(self._sock, 8)
+                    if hdr is None:
+                        raise ConnectionError("store connection closed")
+                    status, dlen = struct.unpack("<II", hdr)
+                    data = (PyTCPStoreServer._recv_all(self._sock, dlen)
+                            if dlen else b"")
+                    if dlen and data is None:
+                        raise ConnectionError("store connection closed")
+                    break
+                except OSError as e:  # ConnectionError/TimeoutError included
+                    if (op not in _IDEMPOTENT_OPS
+                            or attempt >= _RECONNECT_ATTEMPTS):
+                        # best-effort fresh socket so the NEXT request is not
+                        # doomed by this one's dead connection (this op is
+                        # NOT replayed: at-most-once)
+                        try:
+                            self._sock.close()
+                        except OSError:
+                            pass
+                        try:
+                            self._sock = self._connect(self._host,
+                                                       self._port,
+                                                       timeout=2.0)
+                        except (TimeoutError, OSError):
+                            pass
+                        raise ConnectionError(
+                            f"store request op={op} failed after {attempt} "
+                            f"reconnect attempt(s): {e}") from e
+                    attempt += 1
+                    time.sleep(_RECONNECT_BACKOFF * (2 ** (attempt - 1)))
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    try:
+                        self._sock = self._connect(self._host, self._port,
+                                                   timeout=2.0)
+                    except (TimeoutError, OSError):
+                        pass  # next sendall fails fast -> consumes an attempt
         if status != 0:
             raise RuntimeError(f"store request op={op} failed (status {status})")
         return data or b""

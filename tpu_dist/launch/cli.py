@@ -90,6 +90,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="seconds to wait for every launcher to join the "
                         "restart agreement before giving up (multi-node "
                         "--max_restarts only)")
+    p.add_argument("--restart_backoff", type=float, default=1.0,
+                   help="base seconds between restart rounds; doubles each "
+                        "round (capped at 30s) with up to 25%% jitter so "
+                        "a crash-looping world does not hammer the "
+                        "rendezvous")
+    p.add_argument("--heartbeat_timeout", type=float, default=0.0,
+                   help="seconds of heartbeat silence after which a worker "
+                        "counts as lost (RankLostError): the supervisor "
+                        "kills the gang and, with --max_restarts, "
+                        "relaunches it. Needs the store and workers that "
+                        "publish heartbeats (resilience.Heartbeat / "
+                        "resilience.TrainState; this flag is exported to "
+                        "them as TPU_DIST_HEARTBEAT_TIMEOUT). 0 disables "
+                        "the watchdog — a hung rank then waits on the "
+                        "coordination-service timeout as before")
     p.add_argument("--standalone", action="store_true",
                    help="single-node mode with automatic rendezvous "
                         "(torchrun parity): forces --nnodes=1 "
@@ -204,6 +219,9 @@ def _spawn_world(args, world_size: int, master_port: int,
                        TPU_DIST_RESTART_COUNT=str(restart_count))
             if store_addr is not None:
                 env["TPU_DIST_STORE_ADDR"] = store_addr
+            if args.heartbeat_timeout > 0:
+                env["TPU_DIST_HEARTBEAT_TIMEOUT"] = str(
+                    args.heartbeat_timeout)
             cmd = [sys.executable]
             if args.module:
                 cmd += ["-m", args.script]
@@ -254,6 +272,21 @@ def _watch_world(args, procs: List[subprocess.Popen], store,
     fail_key = f"tpu_dist/elastic/fail/{rnd}"
     last_remote_check = 0.0
     remote_failed = False
+    # Heartbeat watchdog: a rank that is ALIVE but silent (hung collective,
+    # stalled host) never trips the exit-code fail-fast below; the monitor
+    # converts it into a named RankLostError within the deadline.  Ranks
+    # that have not yet published get max(timeout, liveness_warn) of
+    # startup grace (workers must import jax before their first beat).
+    monitor = None
+    hb_poll_every = 0.0
+    last_hb_check = 0.0
+    if args.heartbeat_timeout > 0 and store is not None:
+        from ..resilience.heartbeat import HeartbeatMonitor
+        monitor = HeartbeatMonitor(
+            store, world_size, timeout=args.heartbeat_timeout,
+            generation=rnd,
+            startup_grace=max(args.heartbeat_timeout, args.liveness_warn))
+        hb_poll_every = min(0.5, args.heartbeat_timeout / 4)
     try:
         remaining = set(range(len(procs)))
         while remaining:
@@ -273,6 +306,11 @@ def _watch_world(args, procs: List[subprocess.Popen], store,
                 if rc is None:
                     continue
                 remaining.discard(i)
+                if rc == 0 and monitor is not None:
+                    # finished ranks are done, not lost — even if they
+                    # raced past their terminal exit beat
+                    monitor.mark_done(
+                        args.node_rank * args.nproc_per_node + i)
                 if rc != 0 and exit_code == 0:
                     exit_code = rc
                     if elastic:
@@ -298,6 +336,23 @@ def _watch_world(args, procs: List[subprocess.Popen], store,
                         kill_deadline = time.monotonic() + kill_grace
                 except Exception:
                     pass
+            if (monitor is not None and exit_code == 0 and not remote_failed
+                    and time.monotonic() - last_hb_check > hb_poll_every):
+                last_hb_check = time.monotonic()
+                lost = monitor.poll()
+                if lost:
+                    monitor = None  # diagnosed; stop polling
+                    sys.stderr.write(
+                        f"[tpu_dist.launch] RankLostError: {lost[0]}\n")
+                    exit_code = 1
+                    if elastic:
+                        try:
+                            store.set(fail_key, str(args.node_rank).encode())
+                        except Exception:
+                            pass
+                    for j in remaining:
+                        procs[j].terminate()
+                    kill_deadline = time.monotonic() + kill_grace
             if (kill_deadline is not None
                     and time.monotonic() > kill_deadline):
                 for j in remaining:
@@ -326,21 +381,54 @@ def _watch_world(args, procs: List[subprocess.Popen], store,
     return exit_code, interrupted
 
 
-def _reset_round_state(store, world_size: int) -> None:
+def _reset_round_state(store, world_size: int,
+                       finished_round: Optional[int] = None) -> None:
     """Reset last round's control-plane state before a restart: liveness
     marks AND the teardown-barrier arrival counter — a partial teardown
     (one rank crashed mid-round) leaves the counter off-generation, which
     would make the next round's first teardown caller sail through the
-    barrier early."""
+    barrier early.  The finished round's heartbeat keys go too (they are
+    generation-scoped, so this is pure GC — a stale publisher cannot
+    refresh the next round's keys either way)."""
     for r in range(world_size):
         try:
             store.delete_key(f"tpu_dist/alive/{r}")
         except Exception:
             pass
+        if finished_round is not None:
+            try:
+                store.delete_key(f"tpu_dist/hb/{finished_round}/{r}")
+            except Exception:
+                pass
     try:
         store.delete_key("__barrier__/teardown")
     except Exception:
         pass
+
+
+def _publish_generation(store, rnd: int) -> None:
+    """Fence out stragglers from previous incarnations: children compare
+    their TPU_DIST_RESTART_COUNT against this key at rendezvous pre-flight
+    (tpu_dist/dist/rendezvous.py)."""
+    try:
+        store.set("tpu_dist/generation", str(rnd))
+    except Exception:
+        pass
+
+
+def _restart_backoff(args, restarts: int) -> None:
+    """Exponential backoff + jitter before a relaunch round: restart storms
+    against a struggling host/store help nobody, and the jitter de-phases
+    multi-node launchers racing to re-rendezvous."""
+    import random
+
+    if args.restart_backoff <= 0:
+        return
+    delay = (min(args.restart_backoff * 2 ** (restarts - 1), 30.0)
+             * (1.0 + 0.25 * random.random()))
+    sys.stderr.write(f"[tpu_dist.launch] backing off {delay:.1f}s before "
+                     f"restart {restarts}\n")
+    time.sleep(delay)
 
 
 def _elastic_exit_sync(args, store, rnd: int) -> None:
@@ -407,7 +495,8 @@ def _elastic_agree(args, store, rnd: int, local_rc: int,
         if args.node_rank == 0:
             if negotiated_port:
                 rc_port = _free_port()
-            _reset_round_state(store, args.nproc_per_node * nnodes)
+            _reset_round_state(store, args.nproc_per_node * nnodes,
+                               finished_round=rnd)
             store.set(f"{prefix}/go/{rnd}", str(rc_port).encode())
         else:
             store.wait([f"{prefix}/go/{rnd}"],
@@ -467,6 +556,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     restarts = 0
     try:
         while True:
+            if store is not None and args.node_rank == 0:
+                _publish_generation(store, restarts)
             procs = _spawn_world(args, world_size, master_port, store_addr,
                                  restarts)
             exit_code, interrupted = _watch_world(args, procs, store,
@@ -489,6 +580,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                     f"[tpu_dist.launch] world failed; agreed restart "
                     f"{restarts}/{args.max_restarts} across "
                     f"{args.nnodes} nodes — relaunching\n")
+                _restart_backoff(args, restarts)
                 continue
             if exit_code == 0 or restarts >= args.max_restarts:
                 return exit_code
@@ -498,7 +590,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                 f"restart {restarts}/{args.max_restarts} — relaunching "
                 f"the world\n")
             if store is not None:
-                _reset_round_state(store, world_size)
+                _reset_round_state(store, world_size,
+                                   finished_round=restarts - 1)
+            _restart_backoff(args, restarts)
             if negotiated_port:
                 # the old coordinator socket may still be in TIME_WAIT;
                 # single-node restarts hand children the fresh port via

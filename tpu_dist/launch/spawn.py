@@ -55,7 +55,10 @@ def _wrap(fn, i, args, error_queue):
     try:
         fn(i, *args)
     except KeyboardInterrupt:
-        pass  # parent handles
+        # 128+SIGINT, the shell convention: an interrupted child must be
+        # distinguishable from a clean exit (the parent used to read this
+        # as success and keep the siblings running to completion)
+        sys.exit(130)
     except Exception:
         error_queue.put((i, traceback.format_exc()))
         sys.exit(1)
@@ -93,25 +96,17 @@ class ProcessContext:
                     raise ProcessRaisedException(
                         f"\n-- Process {i} terminated with the following "
                         f"error:\n{tb}", i, proc.pid)
-                raise ProcessExitedException(
-                    f"process {idx} terminated with exit code {proc.exitcode}",
-                    idx, proc.exitcode)
+                msg = (f"process {idx} terminated with exit code "
+                       f"{proc.exitcode}")
+                if proc.exitcode == 130:
+                    msg += " (KeyboardInterrupt)"
+                raise ProcessExitedException(msg, idx, proc.exitcode)
             if not alive:
                 return True
             alive[0].join(timeout=0.25)
 
 
-def spawn(fn, args: Tuple = (), nprocs: int = 1, join: bool = True,
-          daemon: bool = False, start_method: str = "spawn"):
-    """Spawn ``nprocs`` processes running ``fn(i, *args)``.
-
-    Matches the torch API (/root/reference/mpspawn_dist.py:140).  ``fn`` must
-    be picklable (module-level).  With ``join=True`` blocks until all
-    children finish, raising on the first failure; otherwise returns a
-    :class:`ProcessContext`.
-    """
-    if nprocs < 1:
-        raise ValueError(f"nprocs must be >= 1, got {nprocs}")
+def _spawn_once(fn, args, nprocs, daemon, start_method) -> ProcessContext:
     ctx = mp.get_context(start_method)
     error_queue = ctx.SimpleQueue()
     processes = []
@@ -120,8 +115,67 @@ def spawn(fn, args: Tuple = (), nprocs: int = 1, join: bool = True,
                         daemon=daemon)
         p.start()
         processes.append(p)
-    pc = ProcessContext(processes, error_queue)
-    if join:
-        pc.join()
-        return None
-    return pc
+    return ProcessContext(processes, error_queue)
+
+
+def spawn(fn, args: Tuple = (), nprocs: int = 1, join: bool = True,
+          daemon: bool = False, start_method: str = "spawn",
+          max_restarts: int = 0, restart_backoff: float = 1.0):
+    """Spawn ``nprocs`` processes running ``fn(i, *args)``.
+
+    Matches the torch API (/root/reference/mpspawn_dist.py:140).  ``fn`` must
+    be picklable (module-level).  With ``join=True`` blocks until all
+    children finish, raising on the first failure; otherwise returns a
+    :class:`ProcessContext`.
+
+    ``max_restarts=N`` (requires ``join=True``) supervises the gang: on a
+    failure the remaining children are torn down (the usual fail-fast),
+    then the whole world is respawned up to N times with exponential
+    backoff + jitter starting at ``restart_backoff`` seconds.  Each round
+    exports ``TPU_DIST_RESTART_COUNT`` (the generation) to the children so
+    rendezvous can fence stale ranks and ``resilience.TrainState.resume``
+    restores the latest checkpoint.  ``max_restarts=0`` (default) never
+    touches the environment and keeps the exact fail-fast semantics.
+    A child that exited 130 (KeyboardInterrupt) is never restarted.
+    """
+    if nprocs < 1:
+        raise ValueError(f"nprocs must be >= 1, got {nprocs}")
+    if max_restarts < 0:
+        raise ValueError(f"max_restarts must be >= 0, got {max_restarts}")
+    if max_restarts and not join:
+        raise ValueError("max_restarts requires join=True (the supervisor "
+                         "must observe child exits)")
+    if not max_restarts:
+        pc = _spawn_once(fn, args, nprocs, daemon, start_method)
+        if join:
+            pc.join()
+            return None
+        return pc
+
+    import random
+    import time
+    attempt = 0
+    prev_gen = os.environ.get("TPU_DIST_RESTART_COUNT")
+    try:
+        while True:
+            os.environ["TPU_DIST_RESTART_COUNT"] = str(attempt)
+            pc = _spawn_once(fn, args, nprocs, daemon, start_method)
+            try:
+                pc.join()
+                return None
+            except (ProcessRaisedException, ProcessExitedException) as e:
+                if (getattr(e, "exit_code", None) == 130
+                        or attempt >= max_restarts):
+                    raise
+                attempt += 1
+                delay = (min(restart_backoff * 2 ** (attempt - 1), 30.0)
+                         * (1.0 + 0.25 * random.random()))
+                sys.stderr.write(
+                    f"[tpu_dist.spawn] world failed ({e}); restart "
+                    f"{attempt}/{max_restarts} in {delay:.1f}s\n")
+                time.sleep(delay)
+    finally:
+        if prev_gen is None:
+            os.environ.pop("TPU_DIST_RESTART_COUNT", None)
+        else:
+            os.environ["TPU_DIST_RESTART_COUNT"] = prev_gen

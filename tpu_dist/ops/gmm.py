@@ -153,13 +153,19 @@ def gmm(x, w, block_groups, n_live_blocks, *, bias=None, block_rows: int = 512,
     # re-fetched the ENTIRE weight tensor per row block (~nb x |w|, the
     # measured ~2.4 ms floor at GPT-2-small MoE shapes); x re-reads per
     # h-tile are the cheaper side of that trade (|x| << nb x |w|).
+    # The no-bias placeholder is (E, 1, block_h) — a single h-block — so its
+    # index_map must pin j to 0 rather than lean on Pallas' out-of-bounds
+    # block-index clamping (never read, but fragile against bounds-checking
+    # changes).
+    bias_index = ((lambda j, i, s: (s[i], 0, j)) if has_bias
+                  else (lambda j, i, s: (s[i], 0, 0)))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(hp // block_h, nb),
         in_specs=[
             pl.BlockSpec((block_rows, dp), lambda j, i, s: (i, 0)),
             pl.BlockSpec((1, dp, block_h), lambda j, i, s: (s[i], 0, j)),
-            pl.BlockSpec((1, 1, block_h), lambda j, i, s: (s[i], 0, j)),
+            pl.BlockSpec((1, 1, block_h), bias_index),
         ],
         out_specs=pl.BlockSpec((block_rows, block_h),
                                lambda j, i, s: (i, j)),

@@ -1,0 +1,40 @@
+"""tpu_dist.resilience — elastic fault tolerance for long-running gangs.
+
+The reference (and our launch/spawn port of it) is fail-fast only: the first
+child exception kills the world (SURVEY.md §5).  This package adds the three
+pieces a preemptible multi-host run needs, plus the harness to test them:
+
+- :mod:`~tpu_dist.resilience.heartbeat` — every rank publishes
+  ``tpu_dist/hb/<generation>/<rank>`` to the control-plane
+  :class:`~tpu_dist.dist.store.TCPStore` on a daemon thread
+  (:class:`Heartbeat`); :class:`HeartbeatMonitor` turns a silent rank into
+  a named :class:`RankLostError` within a configurable deadline instead of
+  an indefinite hang inside a collective.
+- :mod:`~tpu_dist.resilience.state` — :class:`TrainState`, the auto-resume
+  hook over :mod:`tpu_dist.checkpoint`: periodic saves, restore-``latest``
+  after a supervised restart (``python -m tpu_dist.launch --max_restarts``),
+  heartbeat progress, and chaos step hooks, all from two calls in the loop.
+- :mod:`~tpu_dist.resilience.chaos` — deterministic, env/config-driven
+  fault injection (kill rank *r* at step *k*, drop/delay store connections,
+  stall a heartbeat) so the restart machinery is exercised by tier-1 tests
+  on the CPU backend, not just believed.
+
+Restart fencing lives in :mod:`tpu_dist.dist.rendezvous`: the launcher
+bumps ``tpu_dist/generation`` in the store each round and a rank from an
+older incarnation is rejected at pre-flight instead of corrupting the new
+gang (veScale/torchelastic-style generation fencing).
+"""
+
+from .chaos import (Chaos, ChaosError, Fault, active as active_chaos,
+                    install as install_chaos,
+                    install_from_env as install_chaos_from_env,
+                    uninstall as uninstall_chaos)
+from .heartbeat import Heartbeat, HeartbeatMonitor, RankLostError, hb_key
+from .state import TrainState
+
+__all__ = [
+    "Heartbeat", "HeartbeatMonitor", "RankLostError", "hb_key",
+    "TrainState",
+    "Chaos", "ChaosError", "Fault", "active_chaos", "install_chaos",
+    "install_chaos_from_env", "uninstall_chaos",
+]

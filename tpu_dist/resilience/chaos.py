@@ -1,0 +1,210 @@
+"""Deterministic fault injection — the harness that keeps the elastic layer
+honest.
+
+Faults are declared in a compact spec string, usually via the
+``TPU_DIST_CHAOS`` env var so any worker can be subjected to failure without
+code changes (``rendezvous`` installs it automatically when set)::
+
+    TPU_DIST_CHAOS="kill:rank=1,step=5"
+    TPU_DIST_CHAOS="stall-heartbeat:rank=0,step=3;delay-store:rank=1,op=1,delay=0.2"
+
+Grammar: ``fault[;fault...]`` where ``fault = kind[:k=v[,k=v...]]``.  Kinds:
+
+=================  ==========================================================
+``kill``           SIGKILL this process when ``on_step(step)`` hits ``step``
+                   (the hard preemption: no teardown, no atexit)
+``exit``           ``os._exit(code)`` at ``step`` (default code 1)
+``raise``          raise :class:`ChaosError` at ``step`` (the exception path
+                   through the launcher's fail-fast)
+``stall-heartbeat``  stop publishing heartbeats from ``step`` on while the
+                   process stays alive — the hung-collective simulation
+``drop-store``     close the store client socket right before its ``op``-th
+                   request (a deterministic ECONNRESET; exercises the
+                   reconnect path for idempotent ops)
+``delay-store``    sleep ``delay`` seconds before every store request from
+                   the ``op``-th on (a slow/flaky control-plane link)
+=================  ==========================================================
+
+Every fault takes an optional ``rank=`` (default: all ranks).  All triggers
+are counted, not timed — the same spec replays the same failure at the same
+point every run, which is what lets the chaos e2e tests assert bit-for-bit
+resume trajectories.
+
+``drop-store``/``delay-store`` act through a hook consulted by the
+pure-Python store client (:data:`tpu_dist.dist.store.FAULT_HOOK`); run chaos
+jobs with ``TPU_DIST_PURE_PYTHON_STORE=1`` so the native C++ client does not
+bypass it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import threading
+import time
+from typing import List, Optional
+
+__all__ = ["Chaos", "ChaosError", "Fault", "parse", "install",
+           "install_from_env", "uninstall", "active"]
+
+_KINDS = ("kill", "exit", "raise", "stall-heartbeat", "drop-store",
+          "delay-store")
+_STEP_KINDS = ("kill", "exit", "raise", "stall-heartbeat")
+_STORE_KINDS = ("drop-store", "delay-store")
+
+
+class ChaosError(RuntimeError):
+    """The injected exception for ``raise`` faults."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    kind: str
+    rank: Optional[int] = None   # None = every rank
+    step: Optional[int] = None   # step-triggered kinds
+    op: Optional[int] = None     # store-op-triggered kinds (1-based count)
+    delay: float = 0.0           # delay-store only
+    code: int = 1                # exit only
+
+    def __post_init__(self):
+        if self.kind not in _KINDS:
+            raise ValueError(f"unknown chaos fault kind {self.kind!r}; "
+                             f"one of {_KINDS}")
+        if self.kind in _STEP_KINDS and self.step is None:
+            raise ValueError(f"{self.kind} fault requires step=")
+        if self.kind in _STORE_KINDS and self.op is None:
+            raise ValueError(f"{self.kind} fault requires op=")
+        if self.kind == "delay-store" and self.delay <= 0:
+            raise ValueError("delay-store fault requires delay=<seconds>")
+
+
+def parse(spec: str) -> List[Fault]:
+    """Parse a spec string (see module docstring) into faults."""
+    faults = []
+    for part in filter(None, (p.strip() for p in spec.split(";"))):
+        kind, _, params = part.partition(":")
+        kwargs = {}
+        for kv in filter(None, (p.strip() for p in params.split(","))):
+            k, sep, v = kv.partition("=")
+            if not sep:
+                raise ValueError(f"malformed chaos param {kv!r} in {part!r} "
+                                 f"(expected key=value)")
+            k = k.strip()
+            if k in ("rank", "step", "op", "code"):
+                kwargs[k] = int(v)
+            elif k == "delay":
+                kwargs[k] = float(v)
+            else:
+                raise ValueError(f"unknown chaos param {k!r} in {part!r}")
+        faults.append(Fault(kind.strip(), **kwargs))
+    if not faults:
+        raise ValueError(f"empty chaos spec {spec!r}")
+    return faults
+
+
+class Chaos:
+    """The installed fault set, bound to this process's rank.
+
+    Trigger points (all cheap no-ops when nothing matches):
+
+    - :meth:`on_step` — called by ``resilience.TrainState.end_step`` (or a
+      hand-rolled loop) at each step boundary; fires kill/exit/raise.
+    - :meth:`heartbeat_stalled` — consulted by :class:`~.heartbeat.Heartbeat`
+      before each beat.
+    - :meth:`store_op` — the store client hook; fires drop/delay faults on a
+      deterministic per-process request count.
+    """
+
+    def __init__(self, faults: List[Fault], rank: Optional[int] = None):
+        self.faults = list(faults)
+        self.rank = (rank if rank is not None
+                     else int(os.environ.get("RANK", "0") or 0))
+        self._op_count = 0
+        self._mu = threading.Lock()
+
+    def _mine(self, f: Fault) -> bool:
+        return f.rank is None or f.rank == self.rank
+
+    def on_step(self, step: int) -> None:
+        for f in self.faults:
+            if not self._mine(f) or f.step != step:
+                continue
+            if f.kind == "kill":
+                _log("chaos-kill", rank=self.rank, step=step)
+                os.kill(os.getpid(), signal.SIGKILL)
+            elif f.kind == "exit":
+                _log("chaos-exit", rank=self.rank, step=step, code=f.code)
+                os._exit(f.code)
+            elif f.kind == "raise":
+                raise ChaosError(
+                    f"injected failure on rank {self.rank} at step {step}")
+
+    def heartbeat_stalled(self, step: Optional[int],
+                          rank: Optional[int] = None) -> bool:
+        r = self.rank if rank is None else rank
+        return any(f.kind == "stall-heartbeat"
+                   and (f.rank is None or f.rank == r)
+                   and step is not None and step >= f.step
+                   for f in self.faults)
+
+    def store_op(self, client, op: int, key: str) -> None:
+        with self._mu:
+            self._op_count += 1
+            n = self._op_count
+        for f in self.faults:
+            if not self._mine(f):
+                continue
+            if f.kind == "drop-store" and f.op == n:
+                _log("chaos-drop-store", rank=self.rank, op=n, key=key)
+                sock = getattr(client, "_sock", None)
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+            elif f.kind == "delay-store" and n >= f.op:
+                time.sleep(f.delay)
+
+
+def _log(event: str, **fields) -> None:
+    from ..utils.logging import log_event
+    log_event(event, **fields)
+
+
+_ACTIVE: Optional[Chaos] = None
+_ACTIVE_SPEC: Optional[str] = None
+
+
+def install(spec: str, rank: Optional[int] = None) -> Chaos:
+    """Parse ``spec``, make it the process-wide active chaos, and wire the
+    store fault hook.  Replaces any previously installed chaos."""
+    global _ACTIVE, _ACTIVE_SPEC
+    chaos = Chaos(parse(spec), rank=rank)
+    _ACTIVE, _ACTIVE_SPEC = chaos, spec
+    from ..dist import store as _store_mod
+    _store_mod.FAULT_HOOK = chaos.store_op
+    _log("chaos-installed", rank=chaos.rank, spec=spec)
+    return chaos
+
+
+def install_from_env() -> Optional[Chaos]:
+    """Install from ``TPU_DIST_CHAOS`` if set (idempotent: reinstalling the
+    same spec keeps the existing op counters); None when unset."""
+    spec = os.environ.get("TPU_DIST_CHAOS")
+    if not spec:
+        return _ACTIVE
+    if _ACTIVE is not None and _ACTIVE_SPEC == spec:
+        return _ACTIVE
+    return install(spec)
+
+
+def uninstall() -> None:
+    global _ACTIVE, _ACTIVE_SPEC
+    _ACTIVE, _ACTIVE_SPEC = None, None
+    from ..dist import store as _store_mod
+    _store_mod.FAULT_HOOK = None
+
+
+def active() -> Optional[Chaos]:
+    return _ACTIVE

@@ -1,13 +1,14 @@
 """tpu_dist.utils — observability helpers (SURVEY.md §5: the reference's
 tracing/metrics rows are bare prints; these are the structured equivalents)."""
 
-from .logging import MetricLogger, rank_zero_print
+from .logging import MetricLogger, log_event, rank_zero_print
 from .memory import (max_memory_allocated, mem_get_info, memory_allocated,
                      memory_stats, memory_summary)
 from .metrics import accuracy, confusion_matrix, topk_accuracy
 from .profiler import StepTimer, trace
 
-__all__ = ["rank_zero_print", "MetricLogger", "StepTimer", "trace",
+__all__ = ["rank_zero_print", "MetricLogger", "log_event", "StepTimer",
+           "trace",
            "topk_accuracy", "accuracy", "confusion_matrix",
            "memory_stats", "memory_allocated", "max_memory_allocated",
            "mem_get_info", "memory_summary"]

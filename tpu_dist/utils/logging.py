@@ -8,9 +8,12 @@ boilerplate and without forcing a device sync every step.
 
 from __future__ import annotations
 
+import os
+import sys
+
 from typing import Dict, Optional
 
-__all__ = ["rank_zero_print", "MetricLogger"]
+__all__ = ["rank_zero_print", "MetricLogger", "log_event"]
 
 
 def rank_zero_print(*args, **kwargs) -> None:
@@ -18,6 +21,21 @@ def rank_zero_print(*args, **kwargs) -> None:
     from .. import dist as _dist
     if not _dist.is_initialized() or _dist.get_rank() == 0:
         print(*args, **kwargs)
+
+
+def log_event(event: str, **fields) -> None:
+    """One-line structured event to stderr, from EVERY rank.
+
+    The resilience layer's diagnostics channel (`[tpu_dist] rank-lost
+    rank=1 ...`): failure/restart/chaos events must never be rank-gated —
+    the rank that would have printed may be the one that died.  Flushes so
+    the line survives an os._exit-style abort right after."""
+    parts = [f"[tpu_dist] {event}"]
+    rank = os.environ.get("RANK")
+    if rank is not None and "rank" not in fields:
+        parts.append(f"rank={rank}")
+    parts.extend(f"{k}={v}" for k, v in fields.items())
+    print(" ".join(parts), file=sys.stderr, flush=True)
 
 
 class MetricLogger:
