@@ -22,10 +22,13 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # ConvNet trained on synthetic data keyed ONLY on (rank, step): any two runs
 # — interrupted or not — see identical batches at identical steps, so loss
 # trajectories and final parameters must agree bit-for-bit.  Grad averaging
-# rides the store-transport gather/scatter collectives (a real cross-process
-# sync every step; XLA multiprocess computations don't exist on this CPU
-# backend, which is also why the workers block on a dead peer — exactly the
-# hang the resilience layer must break).
+# rides all_reduce_host over the p2p DATA PLANE (TPU_DIST_DP_THRESHOLD=1024
+# pushes the conv/dense kernels onto the chunk-pipelined ring; tiny bias
+# leaves batch through the store) — a real cross-process sync every step;
+# XLA multiprocess computations don't exist on this CPU backend, which is
+# also why the workers block on a dead peer — exactly the hang the
+# resilience layer must break.  The ring's fixed accumulation order keeps
+# the resumed trajectory bit-identical to the clean run.
 _TRAIN_WORKER = textwrap.dedent("""
     import hashlib, json, os, sys
     os.environ["JAX_PLATFORMS"] = "cpu"
@@ -68,14 +71,7 @@ _TRAIN_WORKER = textwrap.dedent("""
             x, y = batch(step, rank)
             l, g = fwd_bwd(params, x, y)
             g = jax.tree.map(np.asarray, g)
-            gathered = C.gather_host(g, dst=0, group=pg)
-            if rank == 0:
-                avg = jax.tree.map(
-                    lambda *xs: (np.sum(xs, axis=0) / nproc)
-                    .astype(np.float32), *gathered)
-                g = C.scatter_host(g, [avg] * nproc, src=0, group=pg)
-            else:
-                g = C.scatter_host(g, None, src=0, group=pg)
+            g = C.all_reduce_host(g, group=pg, op="avg")
             params, opt_state = opt.update(g, opt_state, params)
             losses[step] = float(l)
             ts.end_step({"params": params, "opt": opt_state}, step)
@@ -104,6 +100,10 @@ def _launch_train(tmp_path, tag, chaos=None, max_restarts=0, n_steps=10,
     # topology (test_multiprocess_e2e.py): 1 device per process trips
     # "Multiprocess computations aren't implemented on the CPU backend"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    # route the conv/dense gradient leaves over the p2p data plane (ring
+    # all-reduce); tiny bias leaves stay on the store path — both transports
+    # are exercised by THE acceptance run
+    env["TPU_DIST_DP_THRESHOLD"] = "1024"
     if chaos is not None:
         env["TPU_DIST_CHAOS"] = chaos
     else:

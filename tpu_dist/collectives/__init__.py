@@ -17,6 +17,12 @@ implicitly by DDP in every ``loss.backward()``):
 ring — the literal algorithm the reference README teaches, runnable on the
 TPU torus; numerically equal to ``psum`` (tested) but kept for teaching and
 as a building block for later pipeline/sequence parallelism.
+
+The eager collectives themselves ride two host transports (see
+docs/collectives.md): the control-plane TCPStore for small payloads, and a
+direct rank↔rank socket **data plane** (:mod:`.transport`) over which large
+array payloads run the same ring algorithm between *processes*
+(:mod:`.ring`: chunk-pipelined ring all-reduce/all-gather, tree broadcast).
 """
 
 from .ops import (all_gather, all_reduce, all_to_all, broadcast, pmean,
@@ -26,6 +32,11 @@ from .eager import (ReduceOp, all_gather_host, all_gather_object,
                     broadcast_object_list, gather_host, gather_object, recv,
                     reduce_host, scatter_host, scatter_object_list, send,
                     send_recv_device)
+# host-side data plane: the ring/tree collectives large eager payloads ride
+# (module-qualified — ``ring.ring_all_reduce`` is the host-payload twin of
+# the in-jit ``ops.ring_all_reduce`` above)
+from . import ring, transport
+from .transport import DataPlane, PeerGoneError
 
 __all__ = [
     "all_reduce", "all_gather", "reduce_scatter", "broadcast", "all_to_all",
@@ -35,4 +46,5 @@ __all__ = [
     "send_recv_device",
     "all_gather_object", "gather_object", "broadcast_object_list",
     "scatter_object_list", "all_to_all_host",
+    "ring", "transport", "DataPlane", "PeerGoneError",
 ]

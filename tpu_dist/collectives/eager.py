@@ -13,16 +13,39 @@ payload).  Single-process groups are a fast no-op/copy, so the same training
 script runs unchanged from 1 host to a pod (the property the reference gets
 from torch.distributed working at world_size=1).
 
-Point-to-point ``send``/``recv`` ride the control-plane TCPStore (the c10d
-TCPStore analogue, tpu_dist/dist/store.py) — available whenever the job was
-brought up through ``tpu_dist.launch`` (default) or with
-``TPU_DIST_STORE_ADDR``/``TPU_DIST_STORE_PREFLIGHT`` set.
+**Two transports** (docs/collectives.md):
+
+- the **control-plane store** (the c10d TCPStore analogue,
+  tpu_dist/dist/store.py) — pickled trees through the central server;
+  available whenever the job was brought up through ``tpu_dist.launch``
+  (default) or with ``TPU_DIST_STORE_ADDR``/``TPU_DIST_STORE_PREFLIGHT``
+  set.  Small payloads, object collectives, and rooted gather/scatter ride
+  it.
+- the **p2p data plane** (tpu_dist/collectives/transport.py) — direct
+  rank↔rank sockets carrying raw ndarray frames.  Array payloads of at
+  least ``TPU_DIST_DP_THRESHOLD`` bytes (default 64 KiB) in
+  ``all_reduce_host``/``all_gather_host``/``broadcast_host``/``send``/
+  ``recv`` are routed over it as chunk-pipelined ring collectives /
+  tree broadcasts (tpu_dist/collectives/ring.py).
+
+Routing is per-leaf and deterministic (it depends only on shapes/dtypes,
+which every rank of a collective shares), so ranks always agree on which
+transport a payload takes.  Without a store both transports are
+unavailable and the mesh collectives (``multihost_utils``) remain the
+fallback, exactly as before.
+
+All coll/p2p store keys are namespaced by the gang *generation*
+(``TPU_DIST_RESTART_COUNT``): a restarted incarnation starts its sequence
+counters at 0 in a fresh keyspace, so stale keys from a failed generation
+can never be matched by the new one.
 """
 
 from __future__ import annotations
 
 import io
+import os
 import pickle
+import time
 import weakref
 from typing import Any, List, Optional
 
@@ -82,34 +105,145 @@ def _default_group(group):
 
 def all_reduce_host(x, group=None, op: str = ReduceOp.SUM):
     """Reduce a per-process host value across processes; returns the reduced
-    value on host (as numpy / python scalar tree)."""
+    value on host (as numpy / python scalar tree).
+
+    Transport: leaves of at least ``TPU_DIST_DP_THRESHOLD`` bytes with a
+    ring-supported op (sum/avg/max/min) ride the p2p data plane as a
+    chunk-pipelined ring all-reduce; everything else batches into one store
+    round.  Without a store: mesh collectives, as before."""
     group = _default_group(group)
     fn = _reduce_fn(op)  # validate op before the fast path returns
     if group.num_processes <= 1:
         return jax.tree.map(np.asarray, x)
-    from jax.experimental import multihost_utils
-    gathered = multihost_utils.process_allgather(x)  # leading axis = process
-    return jax.tree.map(fn, gathered)
+    store = _coll_store()
+    if store is None or _prefer_mesh(group):
+        from jax.experimental import multihost_utils
+        gathered = multihost_utils.process_allgather(x)  # leading axis = proc
+        return jax.tree.map(fn, gathered)
+    return _routed_all_reduce(x, group, store, op, fn)
+
+
+def _routed_all_reduce(x, group, store, op, fn):
+    from . import ring as _ring
+    n = group.num_processes
+    leaves, treedef = jax.tree.flatten(x)
+    arrs = [np.asarray(l) for l in leaves]
+    opl = str(op).lower()
+    seq = _next_seq("allreduce", 0)
+    base = f"{_ns()}/coll/ar/{seq}"
+    ring_idx, small, dp = _partition_and_dp(arrs, group, store, opl)
+    out = [None] * len(arrs)
+    if small:
+        t0 = time.perf_counter()
+        rows = _store_all_gather_payload([arrs[i] for i in small], group,
+                                         store, base + "/sm")
+        for pos, i in enumerate(small):
+            out[i] = fn(np.stack([np.asarray(rows[r][pos])
+                                  for r in range(n)]))
+        _record("all_reduce", "store", sum(arrs[i].nbytes for i in small), t0)
+    comm = _comm_dtype()
+    for j, i in enumerate(ring_idx):
+        t0 = time.perf_counter()
+        out[i] = _ring.ring_all_reduce(dp, arrs[i], op=opl,
+                                       tag=f"{base}/{j}", comm_dtype=comm)
+        _record("all_reduce", "dataplane", arrs[i].nbytes, t0)
+    return jax.tree.unflatten(treedef, out)
 
 
 def all_gather_host(x, group=None):
-    """Gather per-process values; returns tree with leading process axis."""
+    """Gather per-process values; returns tree with leading process axis.
+
+    Transport: large leaves ride the p2p data plane as a ring all-gather,
+    small ones batch through one store round; mesh collectives without a
+    store."""
     group = _default_group(group)
     if group.num_processes <= 1:
         return jax.tree.map(lambda v: np.asarray(v)[None], x)
-    from jax.experimental import multihost_utils
-    return multihost_utils.process_allgather(x)
+    store = _coll_store()
+    if store is None or _prefer_mesh(group):
+        from jax.experimental import multihost_utils
+        return multihost_utils.process_allgather(x)
+    return _routed_all_gather(x, group, store)
+
+
+def _routed_all_gather(x, group, store):
+    from . import ring as _ring
+    n = group.num_processes
+    leaves, treedef = jax.tree.flatten(x)
+    arrs = [np.asarray(l) for l in leaves]
+    seq = _next_seq("allgather", 0)
+    base = f"{_ns()}/coll/ag/{seq}"
+    ring_idx, small, dp = _partition_and_dp(arrs, group, store)
+    out = [None] * len(arrs)
+    if small:
+        t0 = time.perf_counter()
+        rows = _store_all_gather_payload([arrs[i] for i in small], group,
+                                         store, base + "/sm")
+        for pos, i in enumerate(small):
+            out[i] = np.stack([np.asarray(rows[r][pos]) for r in range(n)])
+        _record("all_gather", "store", sum(arrs[i].nbytes for i in small), t0)
+    for j, i in enumerate(ring_idx):
+        t0 = time.perf_counter()
+        out[i] = _ring.ring_all_gather(dp, arrs[i], tag=f"{base}/{j}")
+        _record("all_gather", "dataplane", arrs[i].nbytes, t0)
+    return jax.tree.unflatten(treedef, out)
 
 
 def broadcast_host(x, group=None, src: int = 0):
     """Broadcast process ``src``'s value to all processes (DDP's wrap-time
-    rank-0 parameter broadcast, /root/reference/example_mp.py:53)."""
+    rank-0 parameter broadcast, /root/reference/example_mp.py:53).
+
+    Transport: large leaves ride the p2p data plane as a binomial-tree
+    broadcast (log2(N) point-to-point rounds), small ones as one pickled
+    store key; mesh collectives without a store.  As with the mesh path,
+    every rank passes an ``x`` of the broadcast structure (non-src leaves
+    are shape/dtype templates)."""
     group = _default_group(group)
     if group.num_processes <= 1:
         return jax.tree.map(np.asarray, x)
-    from jax.experimental import multihost_utils
-    return multihost_utils.broadcast_one_to_all(
-        x, is_source=group.rank == src)
+    store = _coll_store()
+    if store is None or _prefer_mesh(group):
+        from jax.experimental import multihost_utils
+        return multihost_utils.broadcast_one_to_all(
+            x, is_source=group.rank == src)
+    _check_peer(src, group, "src")
+    return _routed_broadcast(x, group, store, src)
+
+
+def _routed_broadcast(x, group, store, src):
+    from . import ring as _ring
+    n, me = group.num_processes, group.rank
+    leaves, treedef = jax.tree.flatten(x)
+    arrs = [np.asarray(l) for l in leaves]
+    seq = _next_seq("bcast", src)
+    base = f"{_ns()}/coll/bc/{seq}"
+    tree_idx, small, dp = _partition_and_dp(arrs, group, store)
+    out = [None] * len(arrs)
+    if small:
+        t0 = time.perf_counter()
+        key = f"{base}/sm"
+        if me == src:
+            store.set(key, pickle.dumps([arrs[i] for i in small],
+                                        protocol=pickle.HIGHEST_PROTOCOL))
+            # copy: non-src ranks get fresh arrays off the wire, so src must
+            # not hand back aliases of the caller's input (mutating the
+            # result would silently diverge src from its peers)
+            vals = [np.array(arrs[i]) for i in small]
+        else:
+            _wait_peer_keys(store, [key])  # bounded: src may have died
+            vals = pickle.loads(store.get(key))
+        if me != src and store.add(f"{key}/ack", 1) >= n - 1:
+            store.delete_key(key)
+            store.delete_key(f"{key}/ack")
+        for pos, i in enumerate(small):
+            out[i] = np.asarray(vals[pos])
+        _record("broadcast", "store", sum(arrs[i].nbytes for i in small), t0)
+    for j, i in enumerate(tree_idx):
+        t0 = time.perf_counter()
+        out[i] = _ring.tree_broadcast(dp, arrs[i], src=src,
+                                      tag=f"{base}/{j}")
+        _record("broadcast", "dataplane", arrs[i].nbytes, t0)
+    return jax.tree.unflatten(treedef, out)
 
 
 def _check_peer(rank: int, group, what: str) -> None:
@@ -126,6 +260,12 @@ def reduce_host(x, dst: int = 0, group=None, op: str = ReduceOp.SUM):
     _check_peer(dst, group, "dst")
     if group.num_processes <= 1:
         return jax.tree.map(np.asarray, x)
+    if _coll_store() is not None and not _prefer_mesh(group):
+        # rooted: ride the O(1)-per-rank store gather; only dst reduces
+        gathered = gather_host(x, dst=dst, group=group)
+        if gathered is None:
+            return None
+        return jax.tree.map(lambda *vs: fn(np.stack(vs)), *gathered)
     from jax.experimental import multihost_utils
     gathered = multihost_utils.process_allgather(x)
     if group.rank != dst:
@@ -152,16 +292,195 @@ def _coll_store():
     return rdzv._store
 
 
+def _ns() -> str:
+    """Store-key namespace for this gang incarnation.  Sequence counters
+    (_coll_seq/_p2p_*_seq) are process-local and restart at 0 in a restarted
+    incarnation; scoping every coll/p2p key by generation means stale keys
+    a failed generation left in the store can never collide with the new
+    one's sequence numbers.  One parser of TPU_DIST_RESTART_COUNT exists —
+    rendezvous.generation() — so the eager keyspace and the DataPlane addr
+    keys can never disagree about the incarnation."""
+    import importlib
+    rdzv = importlib.import_module("tpu_dist.dist.rendezvous")
+    return f"tpu_dist/g{rdzv.generation()}"
+
+
 def _coll_key(op: str, root: int, seq: int, peer: int) -> str:
-    return f"tpu_dist/coll/{op}/{root}/{seq}/{peer}"
+    return f"{_ns()}/coll/{op}/{root}/{seq}/{peer}"
 
 
 def _tree_to_bytes(tree) -> bytes:
-    return pickle.dumps(jax.tree.map(np.asarray, tree))
+    # HIGHEST_PROTOCOL: protocol 5 frames large buffers out-of-band
+    # (PEP 574), skipping one full copy of every array on the wire
+    return pickle.dumps(jax.tree.map(np.asarray, tree),
+                        protocol=pickle.HIGHEST_PROTOCOL)
 
 
 def _tree_from_bytes(raw: bytes):
     return pickle.loads(raw)
+
+
+# -- data-plane routing -------------------------------------------------------
+
+
+def _dp_threshold() -> int:
+    """Payload bytes at which an array leaf leaves the store for the data
+    plane (read per call so tests/benchmarks can steer routing)."""
+    try:
+        return int(os.environ.get("TPU_DIST_DP_THRESHOLD", str(64 * 1024)))
+    except ValueError:
+        return 64 * 1024
+
+
+def _comm_dtype():
+    """Optional wire-compression dtype for ring collectives
+    (``TPU_DIST_COMM_DTYPE=bfloat16`` etc.; EQuARX-style lossy wire)."""
+    name = os.environ.get("TPU_DIST_COMM_DTYPE", "").strip()
+    if not name:
+        return None
+    from .transport import _decode_dtype
+    return _decode_dtype(name)
+
+
+def _maybe_data_plane(group, store):
+    """The process's p2p data plane, or None when disabled/single-process.
+
+    The transport decision must be identical on every rank (peers of a ring
+    step block on each other), so it may depend only on configuration that
+    is uniform across the gang: ``TPU_DIST_NO_DATAPLANE`` /
+    ``TPU_DIST_DP_THRESHOLD`` are launcher-level env (inherited by every
+    worker).  A rank whose DataPlane *setup fails* (can't bind a socket)
+    must NOT silently degrade to the store path — its peers would route to
+    the ring and deadlock against it — so setup failure raises and lets the
+    supervisor restart the rank instead."""
+    if _host_transport_is_store_only():
+        return None
+    from . import transport
+    try:
+        return transport.get_data_plane(store, group.rank,
+                                        group.num_processes)
+    except Exception as e:
+        raise RuntimeError(
+            f"rank {group.rank}: p2p data-plane setup failed ({e!r}); "
+            f"failing fast rather than degrading one-sidedly (peers would "
+            f"deadlock routing this rank's payloads to the ring).  Set "
+            f"TPU_DIST_NO_DATAPLANE=1 on ALL ranks to run store-only."
+        ) from e
+
+
+def _prefer_mesh(group) -> bool:
+    """True when host collectives should stay on the XLA mesh collectives
+    (``multihost_utils``) even though a store is up.
+
+    On a real multi-host TPU pod the mesh path rides ICI/DCN through XLA —
+    far faster than any host TCP transport — so it stays the default
+    there.  The host transports take over where mesh collectives do not
+    exist: the CPU backend ("Multiprocess computations aren't implemented")
+    — or when forced with ``TPU_DIST_HOST_TRANSPORT=dataplane|store``
+    (must be set uniformly across ranks; ``mesh`` forces the other way)."""
+    mode = os.environ.get("TPU_DIST_HOST_TRANSPORT", "auto").strip().lower()
+    if mode == "mesh":
+        return True
+    if mode in ("dataplane", "store"):
+        return False
+    return jax.default_backend() not in ("cpu",)
+
+
+def _host_transport_is_store_only() -> bool:
+    return (os.environ.get("TPU_DIST_HOST_TRANSPORT", "auto").strip().lower()
+            == "store")
+
+
+def _dp_enabled() -> bool:
+    if os.environ.get("TPU_DIST_NO_DATAPLANE"):
+        return False
+    return not _host_transport_is_store_only()
+
+
+def _dp_leaf_ok(a: np.ndarray, reduce_op: Optional[str] = None) -> bool:
+    """THE per-leaf routing decision, in one place: True iff this array
+    leaf rides the data plane.  Depends only on dtype/shape and env knobs
+    that are uniform across the gang, so every rank answers identically.
+    ``reduce_op`` restricts to ring-supported ops (reductions need
+    arithmetic; broadcast/gather only move bytes)."""
+    if not _dp_enabled() or a.nbytes < _dp_threshold():
+        return False
+    dt = a.dtype
+    if reduce_op is not None:
+        from . import ring as _ring
+        if reduce_op not in _ring.RING_OPS:
+            return False
+        if dt.kind in "iuf":
+            return True
+    elif dt.kind in "iufb":
+        return True
+    if dt.kind == "V" and dt.fields is None:
+        # ml_dtypes low-precision floats (bfloat16, float8_*) register with
+        # numpy as unstructured void; accept exactly the ones the wire
+        # header can name-decode (structured dtypes stay on the store)
+        from .transport import _decode_dtype
+        try:
+            return _decode_dtype(dt.name) == dt
+        except Exception:
+            return False
+    return False
+
+
+def _partition_and_dp(arrs, group, store, reduce_op=None):
+    """Split leaves into (data-plane indices, store indices) and bring up
+    the DataPlane lazily — the listener socket + accept thread only exist
+    in processes that actually route a leaf there."""
+    big = {i for i, a in enumerate(arrs) if _dp_leaf_ok(a, reduce_op)}
+    dp = _maybe_data_plane(group, store) if big else None
+    return sorted(big), [i for i in range(len(arrs)) if i not in big], dp
+
+
+def _record(op: str, path: str, nbytes: int, t0: float) -> None:
+    from ..utils import metrics
+    metrics.record_collective(op, path, nbytes, time.perf_counter() - t0)
+
+
+def _next_seq(op: str, root: int) -> int:
+    seq = _coll_seq.get((op, root), 0)
+    _coll_seq[(op, root)] = seq + 1
+    return seq
+
+
+def _wait_peer_keys(store, keys) -> None:
+    """Bounded wait for peer-posted store keys: a peer that died mid-step
+    must surface as a named timeout (same deadline knob as the data plane),
+    not an infinite poll the supervisor has to break from outside."""
+    from .transport import _default_timeout
+    timeout = _default_timeout()
+    try:
+        store.wait(keys, timeout=timeout if timeout > 0 else None)
+    except TimeoutError as e:
+        raise TimeoutError(
+            f"store collective: peer key never posted within "
+            f"{timeout:.0f}s (TPU_DIST_DP_TIMEOUT) — a peer likely died "
+            f"mid-collective: {e}") from e
+
+
+def _store_all_gather_payload(payload, group, store, base: str) -> dict:
+    """All-gather an arbitrary pickled payload through the store: every rank
+    posts one key, waits for all peers' keys (one pass — no per-key blocking
+    round-trips), then fetches.  Returns {rank: payload}.
+
+    GC: each fetched key carries an ack counter; the last reader (the one
+    whose ack hits world-1) deletes the data and ack keys, so per-call keys
+    never accumulate in the server."""
+    n, me = group.num_processes, group.rank
+    store.set(f"{base}/{me}", pickle.dumps(payload,
+                                           protocol=pickle.HIGHEST_PROTOCOL))
+    peers = [r for r in range(n) if r != me]
+    _wait_peer_keys(store, [f"{base}/{r}" for r in peers])
+    rows = {me: payload}
+    for r in peers:
+        rows[r] = pickle.loads(store.get(f"{base}/{r}"))
+        if store.add(f"{base}/{r}/ack", 1) >= n - 1:
+            store.delete_key(f"{base}/{r}")
+            store.delete_key(f"{base}/{r}/ack")
+    return rows
 
 
 def gather_host(x, dst: int = 0, group=None) -> Optional[List]:
@@ -178,20 +497,32 @@ def gather_host(x, dst: int = 0, group=None) -> Optional[List]:
         return [jax.tree.map(np.asarray, x)]
     store = _coll_store()
     if store is not None:
-        seq = _coll_seq.get(("gather", dst), 0)
-        _coll_seq[("gather", dst)] = seq + 1
+        seq = _next_seq("gather", dst)
+        t0 = time.perf_counter()
         if group.rank != dst:
             store.set(_coll_key("gather", dst, seq, group.rank),
                       _tree_to_bytes(x))
             return None
+        # wait on ALL peer keys first (bounded), then fetch: the sequential
+        # blocking-get version parked the client connection on whichever
+        # rank happened to be slowest, in rank order, with no deadline —
+        # this version has one wait for the stragglers and then drains the
+        # already-posted payloads back-to-back
+        keys = [_coll_key("gather", dst, seq, r) for r in range(n)
+                if r != dst]
+        _wait_peer_keys(store, keys)
         out = []
+        nbytes = 0
         for r in range(n):
             if r == dst:
                 out.append(jax.tree.map(np.asarray, x))
             else:
                 key = _coll_key("gather", dst, seq, r)
-                out.append(_tree_from_bytes(store.get(key)))
+                raw = store.get(key)
+                nbytes += len(raw)
+                out.append(_tree_from_bytes(raw))
                 store.delete_key(key)
+        _record("gather", "store", nbytes, t0)
         return out
     from jax.experimental import multihost_utils
     gathered = multihost_utils.process_allgather(x)
@@ -234,8 +565,7 @@ def scatter_host(output_template, scatter_list: Optional[List] = None,
     # broadcast of the full list + local pick when no store is up.
     store = _coll_store()
     if store is not None:
-        seq = _coll_seq.get(("scatter", src), 0)
-        _coll_seq[("scatter", src)] = seq + 1
+        seq = _next_seq("scatter", src)
         if group.rank == src:
             for dst in range(n):
                 if dst != src:
@@ -345,8 +675,7 @@ def scatter_object_list(scatter_object_input_list: Optional[List[Any]] = None,
     store = _coll_store()
     if store is not None:
         # O(1)-per-rank: one store key per destination (see gather_host)
-        seq = _coll_seq.get(("scatter_obj", src), 0)
-        _coll_seq[("scatter_obj", src)] = seq + 1
+        seq = _next_seq("scatter_obj", src)
         if group.rank == src:
             for dst in range(n):
                 if dst != src:
@@ -387,8 +716,7 @@ def all_to_all_host(input_list: List[Any], group=None) -> List[Any]:
         # column (receives) — not every rank x rank entry like the
         # all-gather fallback
         me = group.rank
-        seq = _coll_seq.get(("a2a", 0), 0)
-        _coll_seq[("a2a", 0)] = seq + 1
+        seq = _next_seq("a2a", 0)
         for q in range(n):
             if q != me:
                 # plain pickle (object transport): entries may be arrays
@@ -428,17 +756,24 @@ def _p2p_store():
 
 
 def _p2p_key(src: int, dst: int, tag: int, seq: int) -> str:
-    return f"tpu_dist/p2p/{src}->{dst}/t{tag}/{seq}"
+    return f"{_ns()}/p2p/{src}->{dst}/t{tag}/{seq}"
+
+
+def _p2p_wire_tag(tag: int, seq: int) -> str:
+    return f"p2p/t{tag}/{seq}"
 
 
 def send(x, dst: int, group=None, tag: int = 0) -> None:
     """torch ``dist.send`` parity: deliver this process's array to process
     ``dst``.  Matched by program order per (src, dst, tag), like torch.
-    Buffered through the store server, so send does not block on the
-    receiver.  Control-plane transport: host serialization over the TCP
-    store — for tensor p2p between devices of the SAME mesh use
-    :func:`send_recv_device` (one ppermute hop over ICI, never touches
-    the host)."""
+
+    Transport: arrays of at least ``TPU_DIST_DP_THRESHOLD`` bytes go as one
+    raw frame over the p2p data plane (direct rank↔rank socket, no pickle);
+    smaller ones are buffered through the store server, so send does not
+    block on the receiver either way.  The receiver matches either
+    transport by the shared (src, dst, tag, seq) discipline.  For tensor
+    p2p between devices of the SAME mesh use :func:`send_recv_device`
+    (one ppermute hop over ICI, never touches the host)."""
     group = _default_group(group)
     me = group.rank
     if dst == me:
@@ -447,11 +782,28 @@ def send(x, dst: int, group=None, tag: int = 0) -> None:
         raise ValueError(f"dst {dst} out of range "
                          f"(num_processes={group.num_processes})")
     store = _p2p_store()
+    # the sequence number is consumed only on a successful handoff: a send
+    # that raises (dead peer, store trouble) leaves the counter untouched,
+    # so a caller that recovers and retries stays matched with the receiver
     seq = _p2p_send_seq.get((me, dst, tag), 0)
-    _p2p_send_seq[(me, dst, tag)] = seq + 1
+    arr = np.asarray(x)
+    t0 = time.perf_counter()
+    # same backend-aware gate as the collectives: on real accelerator
+    # backends (auto mode) p2p keeps riding the always-reachable store —
+    # a pod whose fabric only admits coordinator/store traffic must not
+    # suddenly need rank-to-rank TCP for a send that used to work
+    if _dp_leaf_ok(arr) and not _prefer_mesh(group):
+        dp = _maybe_data_plane(group, store)
+        if dp is not None:
+            dp.send_array(dst, _p2p_wire_tag(tag, seq), arr)
+            _p2p_send_seq[(me, dst, tag)] = seq + 1
+            _record("send", "dataplane", arr.nbytes, t0)
+            return
     buf = io.BytesIO()
-    np.save(buf, np.asarray(x), allow_pickle=False)
+    np.save(buf, arr, allow_pickle=False)
     store.set(_p2p_key(me, dst, tag, seq), buf.getvalue())
+    _p2p_send_seq[(me, dst, tag)] = seq + 1
+    _record("send", "store", arr.nbytes, t0)
 
 
 # mesh (weak) -> {(axis, src, dst): jitted mover}; weak so compiled movers
@@ -503,7 +855,14 @@ def send_recv_device(x, src: int, dst: int, group=None):
 def recv(src: int, group=None, tag: int = 0) -> np.ndarray:
     """torch ``dist.recv`` parity: block until the matching :func:`send`
     from ``src`` arrives; returns the array (no preallocated output buffer
-    needed — shape/dtype travel on the wire)."""
+    needed — shape/dtype travel on the wire).
+
+    The sender picks the transport by payload size, which the receiver
+    cannot know in advance — so with a data plane up, recv polls both the
+    p2p frame queue and the store key for the matching (src, tag, seq)
+    until one delivers.  A sender that dies with the message owed surfaces
+    as :class:`~tpu_dist.collectives.transport.PeerGoneError` instead of a
+    hang."""
     group = _default_group(group)
     me = group.rank
     if src == me:
@@ -512,9 +871,57 @@ def recv(src: int, group=None, tag: int = 0) -> np.ndarray:
         raise ValueError(f"src {src} out of range "
                          f"(num_processes={group.num_processes})")
     store = _p2p_store()
+    # seq consumed only on delivery (mirrors send): a recv that raises
+    # (timeout, dead peer) leaves the counter untouched, so a retry waits
+    # for the SAME in-flight message instead of desynchronizing by one
     seq = _p2p_recv_seq.get((src, me, tag), 0)
-    _p2p_recv_seq[(src, me, tag)] = seq + 1
     key = _p2p_key(src, me, tag, seq)
-    raw = store.get(key)  # blocks until the key exists
-    store.delete_key(key)
-    return np.load(io.BytesIO(raw), allow_pickle=False)
+    t0 = time.perf_counter()
+
+    def _delivered(out, path):
+        _p2p_recv_seq[(src, me, tag)] = seq + 1
+        _record("recv", path, out.nbytes, t0)
+        return out
+
+    def _from_store():
+        raw = store.get(key)
+        store.delete_key(key)
+        return _delivered(np.load(io.BytesIO(raw), allow_pickle=False),
+                          "store")
+
+    dp = (_maybe_data_plane(group, store)
+          if _dp_enabled() and not _prefer_mesh(group) else None)
+    if dp is None:
+        return _from_store()  # blocking get until the key exists
+    from .transport import PeerGoneError, _default_timeout
+    wire_tag = _p2p_wire_tag(tag, seq)
+    delay = 0.0002
+    timeout = _default_timeout()
+    deadline = (time.monotonic() + timeout) if timeout > 0 else None
+    while True:
+        arr = dp.try_recv_array(src, wire_tag)
+        if arr is not None:
+            return _delivered(arr, "dataplane")
+        if store.check(key):
+            return _from_store()
+        gone = dp.peer_gone(src)
+        if gone is not None:
+            # the peer died — re-check both sources once (a frame/key that
+            # landed between our poll and the death report still counts),
+            # then diagnose: the message can never arrive
+            arr = dp.try_recv_array(src, wire_tag)
+            if arr is not None:
+                return _delivered(arr, "dataplane")
+            if store.check(key):
+                continue
+            raise PeerGoneError(src, gone)
+        if deadline is not None and time.monotonic() > deadline:
+            # a sender that died before ever connecting leaves no inbound
+            # socket to diagnose — the deadline converts that into a named
+            # timeout instead of an unbounded dual-transport poll
+            raise TimeoutError(
+                f"recv from rank {src} tag {tag} seq {seq} got neither a "
+                f"data-plane frame nor a store key within "
+                f"{timeout:.0f}s (TPU_DIST_DP_TIMEOUT)")
+        time.sleep(delay)
+        delay = min(delay * 2, 0.02)  # back off: don't hammer the server

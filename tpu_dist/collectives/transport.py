@@ -1,0 +1,458 @@
+"""Peer-to-peer data plane for host collectives — direct rank↔rank sockets.
+
+Why this exists: every ``*_host`` collective used to move its bytes through
+the single control-plane TCPStore server (tpu_dist/dist/store.py) — one
+pickled blob per key, one blocking request round-trip per transfer, all of
+it funnelled through one process.  That is O(world × bytes) at the store
+and it serializes what the reference's theory section says should pipeline
+(ring all-reduce, /root/reference/README.md §1).  The data plane gives each
+rank a listening socket and persistent peer connections; ndarray payloads
+move as raw-byte frames (dtype/shape/tag header — never pickle), chunked so
+send, recv, and the local reduce overlap.  The store remains the *control*
+plane: it only carries each rank's advertised address (a few bytes, once
+per incarnation).
+
+Design notes:
+
+- **One connection per direction.**  ``send_array(dst, ...)`` lazily opens
+  (and keeps) a connection to ``dst``'s listener; inbound connections are
+  identified by a hello frame carrying the peer's rank and generation.
+  A stale-generation hello is refused — a rank left over from a failed
+  incarnation cannot inject frames into the restarted gang.
+- **A receiver thread per inbound connection** drains the socket into
+  per-``(src, tag)`` FIFO queues.  Because the receiving side is *always*
+  reading, a ring step where every rank sends before it receives cannot
+  deadlock on full TCP buffers, and ``recv_array`` overlaps with whatever
+  the caller computes between frames — this is what makes the chunked ring
+  pipeline (tpu_dist/collectives/ring.py) actually pipeline.
+- **Peer death is a named error.**  EOF or a reset on an inbound connection
+  marks that rank gone and wakes every blocked ``recv_array`` with
+  :class:`PeerGoneError` naming the rank — collectives fail fast with a
+  diagnosis instead of hanging until a multi-minute timeout (the same
+  philosophy as the resilience layer's ``RankLostError``).
+
+Env knobs: ``TPU_DIST_DP_HOST`` (advertised address override),
+``TPU_DIST_DP_TIMEOUT`` (recv deadline, seconds, default 300),
+``TPU_DIST_NO_DATAPLANE=1`` (disable; collectives fall back to the store).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["DataPlane", "PeerGoneError", "get_data_plane",
+           "close_data_plane"]
+
+_MAGIC = b"TPDP"
+_HELLO = struct.Struct("<4sII")      # magic, rank, generation
+_U8 = struct.Struct("<B")
+_U16 = struct.Struct("<H")
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+_CONNECT_TIMEOUT = 60.0
+
+
+class PeerGoneError(ConnectionError):
+    """A data-plane peer died (EOF/reset on its connection, or a send to it
+    failed).  Carries the peer's rank so supervisors and tests can name the
+    lost rank instead of pattern-matching an errno."""
+
+    def __init__(self, peer: int, detail: str = ""):
+        self.peer = int(peer)
+        msg = f"data-plane peer rank {peer} is gone"
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+
+
+def _default_timeout() -> float:
+    try:
+        return float(os.environ.get("TPU_DIST_DP_TIMEOUT", "300"))
+    except ValueError:
+        return 300.0
+
+
+def _recv_exact(conn, n: int) -> Optional[bytearray]:
+    """Read exactly ``n`` bytes into a fresh (writable) buffer.
+
+    Returns None on EOF at a frame boundary (peer closed cleanly);
+    raises ConnectionError on EOF mid-read (truncated frame)."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = conn.recv_into(view[got:], n - got)
+        if r == 0:
+            if got == 0:
+                return None
+            raise ConnectionError(f"truncated frame ({got}/{n} bytes)")
+        got += r
+    return buf
+
+
+def _decode_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        # jax's low-precision dtypes (bfloat16, float8_*) register with
+        # numpy through ml_dtypes; resolve by attribute name
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _encode_frame_header(tag: bytes, dtype_name: bytes, shape,
+                         payload_len: int) -> bytes:
+    parts = [_U32.pack(len(tag)), tag,
+             _U16.pack(len(dtype_name)), dtype_name,
+             _U8.pack(len(shape))]
+    parts.extend(_U64.pack(int(d)) for d in shape)
+    parts.append(_U64.pack(payload_len))
+    return b"".join(parts)
+
+
+class DataPlane:
+    """Per-process endpoint of the rank↔rank data plane.
+
+    Opens a listening socket at construction and publishes its address to
+    the control-plane store under
+    ``tpu_dist/g{generation}/dp/addr/{rank}``; peers resolve each other
+    through those keys on first send.  All methods are thread-safe.
+    """
+
+    def __init__(self, store, rank: int, num_processes: int,
+                 generation: int = 0):
+        self.rank = int(rank)
+        self.num_processes = int(num_processes)
+        self.generation = int(generation)
+        self._store = store
+        self._closing = False
+
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("0.0.0.0", 0))
+        self._listener.listen(max(8, num_processes * 2))
+        self.port = self._listener.getsockname()[1]
+
+        # inbound frame queues + liveness, all under one condition variable
+        self._cv = threading.Condition()
+        self._in_q: Dict[Tuple[int, str], deque] = {}
+        self._dead: Dict[int, str] = {}
+        self._in_conn: Dict[int, object] = {}  # peer -> current inbound sock
+
+        # outbound connections, one per destination, each with its own lock
+        # so concurrent senders to different peers do not serialize
+        self._out: Dict[int, socket.socket] = {}
+        self._out_locks: Dict[int, threading.Lock] = {}
+        self._out_mu = threading.Lock()
+
+        self.addr = f"{self._advertised_host()}:{self.port}"
+        store.set(self._addr_key(self.rank), self.addr.encode())
+
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"tpu_dist-dp-accept-r{rank}")
+        self._accept_thread.start()
+
+    # -- addressing ----------------------------------------------------------
+
+    def _addr_key(self, rank: int) -> str:
+        return f"tpu_dist/g{self.generation}/dp/addr/{rank}"
+
+    def _advertised_host(self) -> str:
+        host = os.environ.get("TPU_DIST_DP_HOST")
+        if host:
+            return host
+        target = getattr(self._store, "host", None)
+        if not target or target in ("127.0.0.1", "localhost", "0.0.0.0", ""):
+            return "127.0.0.1"
+        # the address peers can reach us on is whatever interface routes
+        # toward the store server (UDP connect does no traffic)
+        try:
+            probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            try:
+                probe.connect((target, int(getattr(self._store, "port", 1))))
+                return probe.getsockname()[0]
+            finally:
+                probe.close()
+        except OSError:
+            return "127.0.0.1"
+
+    # -- inbound -------------------------------------------------------------
+
+    def _accept_loop(self):
+        while not self._closing:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._reader, args=(conn,), daemon=True,
+                             name=f"tpu_dist-dp-reader-r{self.rank}").start()
+
+    def _reader(self, conn):
+        peer = None
+        detail = "connection closed"
+        try:
+            hello = _recv_exact(conn, _HELLO.size)
+            if hello is None:
+                return
+            magic, peer, gen = _HELLO.unpack(bytes(hello))
+            if magic != _MAGIC:
+                peer = None
+                return
+            if gen != self.generation:
+                # straggler from a failed incarnation: refuse its frames,
+                # but do NOT mark the rank dead in THIS generation
+                peer = None
+                return
+            with self._cv:
+                # a valid hello supersedes any earlier death mark: the peer
+                # reconnected after a transient drop, so future recvs must
+                # wait for its frames again instead of failing spuriously
+                self._dead.pop(peer, None)
+                self._in_conn[peer] = conn
+            while True:
+                frame = self._read_frame(conn)
+                if frame is None:
+                    break
+                tag, arr = frame
+                with self._cv:
+                    self._in_q.setdefault((peer, tag), deque()).append(arr)
+                    self._cv.notify_all()
+        except OSError as e:
+            detail = repr(e)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            if peer is not None and not self._closing:
+                with self._cv:
+                    # only this peer's CURRENT connection may declare it
+                    # dead: a stale reader observing its own superseded
+                    # socket's reset must not flag a reconnected live peer
+                    if self._in_conn.get(peer) is conn:
+                        del self._in_conn[peer]
+                        self._dead[peer] = detail
+                        self._cv.notify_all()
+
+    def _read_frame(self, conn):
+        raw = _recv_exact(conn, _U32.size)
+        if raw is None:
+            return None
+        (tlen,) = _U32.unpack(bytes(raw))
+        tag = bytes(_recv_exact_or_raise(conn, tlen)).decode()
+        (dlen,) = _U16.unpack(bytes(_recv_exact_or_raise(conn, _U16.size)))
+        dtype = _decode_dtype(bytes(_recv_exact_or_raise(conn, dlen)).decode())
+        (ndim,) = _U8.unpack(bytes(_recv_exact_or_raise(conn, _U8.size)))
+        shape = tuple(
+            _U64.unpack(bytes(_recv_exact_or_raise(conn, _U64.size)))[0]
+            for _ in range(ndim))
+        (plen,) = _U64.unpack(bytes(_recv_exact_or_raise(conn, _U64.size)))
+        payload = (_recv_exact_or_raise(conn, plen) if plen else bytearray())
+        # zero-copy: the ndarray wraps the receive buffer (writable, owned
+        # by the frame) — no pickle, no second materialization
+        arr = np.frombuffer(payload, dtype=dtype)
+        if arr.size != int(np.prod(shape, dtype=np.int64)):
+            raise ConnectionError(
+                f"frame payload {plen}B does not match shape {shape} "
+                f"dtype {dtype}")
+        return tag, arr.reshape(shape)
+
+    # -- outbound ------------------------------------------------------------
+
+    def _out_lock(self, dst: int) -> threading.Lock:
+        with self._out_mu:
+            lock = self._out_locks.get(dst)
+            if lock is None:
+                lock = self._out_locks[dst] = threading.Lock()
+            return lock
+
+    def _connect(self, dst: int) -> socket.socket:
+        # bounded wait for the peer's address: a blocking store.get here
+        # would hang forever (holding this destination's send lock) when
+        # the peer died before constructing its DataPlane
+        key = self._addr_key(dst)
+        timeout = _default_timeout()
+        try:
+            self._store.wait([key], timeout=timeout if timeout > 0 else None)
+        except TimeoutError as e:
+            raise PeerGoneError(
+                dst, f"never published a data-plane address: {e}") from e
+        raw = self._store.get(key)
+        host, _, port = raw.decode().rpartition(":")
+        sock = socket.create_connection((host, int(port)),
+                                        timeout=_CONNECT_TIMEOUT)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.settimeout(None)
+        sock.sendall(_HELLO.pack(_MAGIC, self.rank, self.generation))
+        return sock
+
+    def send_array(self, dst: int, tag: str, arr) -> int:
+        """Send one array frame to ``dst``; returns payload bytes sent.
+
+        Blocking, but never deadlocks against a peer doing the same: the
+        peer's reader thread is always draining its socket.  Raises
+        :class:`PeerGoneError` if the connection to ``dst`` fails."""
+        if dst == self.rank:
+            raise ValueError("data plane does not deliver to self")
+        arr = np.asarray(arr)
+        shape = arr.shape  # before ascontiguousarray, which flattens 0-d
+        arr = np.ascontiguousarray(arr)
+        try:
+            payload = memoryview(arr).cast("B")
+        except (TypeError, ValueError):
+            payload = arr.tobytes()  # exotic dtypes without buffer support
+        header = _encode_frame_header(
+            tag.encode(), arr.dtype.name.encode(), shape, len(payload))
+        with self._out_lock(dst):
+            sock = self._out.get(dst)
+            try:
+                if sock is None:
+                    sock = self._connect(dst)
+                    self._out[dst] = sock
+                sock.sendall(header)
+                if len(payload):
+                    sock.sendall(payload)
+            except PeerGoneError:
+                raise  # _connect already diagnosed the peer
+            except OSError as e:
+                self._out.pop(dst, None)
+                try:
+                    if sock is not None:
+                        sock.close()
+                except OSError:
+                    pass
+                raise PeerGoneError(dst, repr(e)) from e
+        return len(payload)
+
+    # -- receive -------------------------------------------------------------
+
+    def try_recv_array(self, src: int, tag: str):
+        """Non-blocking: the next queued frame from ``(src, tag)`` or None."""
+        with self._cv:
+            return self._pop_locked(src, tag)
+
+    def _pop_locked(self, src: int, tag: str):
+        q = self._in_q.get((src, tag))
+        if q:
+            arr = q.popleft()
+            if not q:
+                del self._in_q[(src, tag)]
+            return arr
+        return None
+
+    def peer_gone(self, src: int) -> Optional[str]:
+        """Detail string if ``src``'s inbound connection died, else None."""
+        with self._cv:
+            return self._dead.get(src)
+
+    def recv_array(self, src: int, tag: str,
+                   timeout: Optional[float] = None) -> np.ndarray:
+        """Block until a frame from ``(src, tag)`` arrives and return it.
+
+        Frames from one peer arrive in send order (TCP + one connection per
+        direction), so repeated calls with the same tag see the sender's
+        chunk sequence in order.  Raises :class:`PeerGoneError` when the
+        peer's connection died with frames still owed, ``TimeoutError``
+        after ``timeout`` seconds (default ``TPU_DIST_DP_TIMEOUT``, 300)."""
+        if timeout is None:
+            timeout = _default_timeout()
+        deadline = (time.monotonic() + timeout) if timeout > 0 else None
+        with self._cv:
+            while True:
+                arr = self._pop_locked(src, tag)
+                if arr is not None:
+                    return arr
+                if src in self._dead:
+                    raise PeerGoneError(src, self._dead[src])
+                if self._closing:
+                    raise RuntimeError("data plane closed during recv")
+                if deadline is None:
+                    self._cv.wait(1.0)
+                else:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        raise TimeoutError(
+                            f"data-plane recv from rank {src} tag {tag!r} "
+                            f"timed out after {timeout:.0f}s")
+                    self._cv.wait(min(left, 1.0))
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        if self._closing:
+            return
+        self._closing = True
+        try:
+            self._store.delete_key(self._addr_key(self.rank))
+        except Exception:
+            pass  # store may already be down; the key is generation-scoped
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._out_mu:
+            socks = list(self._out.values())
+            self._out.clear()
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+        with self._cv:
+            self._cv.notify_all()
+
+    def __repr__(self):
+        return (f"DataPlane(rank={self.rank}/{self.num_processes}, "
+                f"addr={self.addr}, generation={self.generation})")
+
+
+def _recv_exact_or_raise(conn, n: int) -> bytearray:
+    buf = _recv_exact(conn, n)
+    if buf is None:
+        raise ConnectionError("connection closed mid-frame")
+    return buf
+
+
+# -- process-wide singleton ---------------------------------------------------
+
+_dp: Optional[DataPlane] = None
+_dp_mu = threading.Lock()
+
+
+def get_data_plane(store, rank: int, num_processes: int) -> Optional[DataPlane]:
+    """The process's data plane, created on first use (None when disabled,
+    single-process, or no store).  One per process per incarnation — the
+    generation comes from ``TPU_DIST_RESTART_COUNT`` like every other
+    incarnation-scoped key."""
+    global _dp
+    if store is None or num_processes <= 1:
+        return None
+    if os.environ.get("TPU_DIST_NO_DATAPLANE"):
+        return None
+    with _dp_mu:
+        if _dp is not None and not _dp._closing:
+            return _dp
+        import importlib
+        gen = importlib.import_module("tpu_dist.dist.rendezvous").generation()
+        _dp = DataPlane(store, rank, num_processes, generation=gen)
+        return _dp
+
+
+def close_data_plane() -> None:
+    """Tear down the process's data plane (called from
+    ``tpu_dist.dist.rendezvous.shutdown``; safe to call twice)."""
+    global _dp
+    with _dp_mu:
+        if _dp is not None:
+            _dp.close()
+            _dp = None

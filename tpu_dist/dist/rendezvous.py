@@ -283,6 +283,15 @@ def shutdown() -> None:
                                              "10")))
         except Exception as e:
             warnings.warn(f"store teardown barrier failed ({e!r})")
+    # close the p2p data plane AFTER the barrier (a peer may still be
+    # flushing a last send at our listener until everyone has arrived) but
+    # while the store is still up (the addr key is deleted through it)
+    try:
+        from ..collectives import transport as _transport
+        _transport.close_data_plane()
+    except Exception:
+        pass
+    if _store is not None:
         try:
             _store.close()
         except Exception:

@@ -1,19 +1,68 @@
-"""Evaluation metrics — jit-friendly counterparts of the torch recipes.
+"""Evaluation metrics — jit-friendly counterparts of the torch recipes —
+plus per-collective transport counters.
 
 The reference computes accuracy host-side per batch
 (`/root/reference/mpspawn_dist.py:125-131`: argmax + eq + sum).  These
 helpers keep the computation in the XLA graph (device reductions, one
 scalar out) and add the standard top-k form.
+
+The collective counters aggregate bytes/latency per (op, transport) for the
+eager host collectives (tpu_dist/collectives/eager.py records into them on
+every call), so a training job can answer "how much gradient traffic rode
+the p2p data plane vs. the store, and at what rate?" without a profiler.
 """
 
 from __future__ import annotations
 
-from typing import Sequence, Tuple
+import threading
+from typing import Dict, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 
-__all__ = ["topk_accuracy", "accuracy", "confusion_matrix"]
+__all__ = ["topk_accuracy", "accuracy", "confusion_matrix",
+           "record_collective", "collective_counters",
+           "reset_collective_counters"]
+
+
+# -- host-collective transport counters ---------------------------------------
+
+_coll_mu = threading.Lock()
+_coll_counters: Dict[str, Dict[str, float]] = {}
+
+
+def record_collective(op: str, transport: str, nbytes: int,
+                      seconds: float) -> None:
+    """Account one eager collective: ``op`` (all_reduce, send, ...) over
+    ``transport`` ('dataplane' | 'store') moving ``nbytes`` of array
+    payload in ``seconds`` of wall time."""
+    key = f"{op}/{transport}"
+    with _coll_mu:
+        c = _coll_counters.get(key)
+        if c is None:
+            c = _coll_counters[key] = {"calls": 0, "bytes": 0, "seconds": 0.0}
+        c["calls"] += 1
+        c["bytes"] += int(nbytes)
+        c["seconds"] += float(seconds)
+
+
+def collective_counters(reset: bool = False) -> Dict[str, Dict[str, float]]:
+    """Snapshot of the per-``op/transport`` counters, each entry
+    ``{calls, bytes, seconds, mb_per_s}``.  ``reset=True`` atomically
+    clears after reading (per-step deltas)."""
+    with _coll_mu:
+        out = {k: dict(v) for k, v in _coll_counters.items()}
+        if reset:
+            _coll_counters.clear()
+    for v in out.values():
+        v["mb_per_s"] = (v["bytes"] / v["seconds"] / 1e6
+                         if v["seconds"] > 0 else 0.0)
+    return out
+
+
+def reset_collective_counters() -> None:
+    with _coll_mu:
+        _coll_counters.clear()
 
 
 def topk_accuracy(logits, targets, ks: Sequence[int] = (1, 5)):
