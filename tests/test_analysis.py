@@ -1,0 +1,532 @@
+"""tpu_dist.analysis (ISSUE 3): tpudlint static rules + the runtime
+cross-rank collective sanitizer.
+
+Static half: one positive + one negative fixture per rule TD001–TD006,
+suppression-comment handling, JSON-output schema, CLI exit codes.
+
+Runtime half: spawned world-2 workers (the test_ring_collectives wiring —
+store + rank shim, no jax.distributed) where one rank calls a mismatched /
+missing collective under ``TPU_DIST_SANITIZE=1`` and every rank must get a
+:class:`CollectiveMismatchError` naming the culprit within the deadline.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from tpu_dist.analysis import lint_source
+from tpu_dist.analysis.findings import render_json
+from tpu_dist.analysis.rules import RULE_DOCS
+
+pytestmark = [pytest.mark.analysis]
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rules(findings):
+    return [f.rule for f in findings if not f.suppressed]
+
+
+# ---------------------------------------------------------------------------
+# rule fixtures: one positive + one negative each
+# ---------------------------------------------------------------------------
+
+TD001_POS = """
+def step(x, rank, group):
+    if rank == 0:
+        y = C.all_reduce_host(x, group=group)
+    return x
+"""
+
+TD001_NEG = """
+def step(x, rank, group):
+    y = C.all_reduce_host(x, group=group)
+    if rank == 0:
+        print(float(y))
+    return y
+"""
+
+TD001_EARLY_EXIT_POS = """
+def step(x, group):
+    if group.rank != 0:
+        return None
+    return C.all_reduce_host(x, group=group)
+"""
+
+TD002_POS = """
+def step(x, rank, group):
+    if rank == 0:
+        y = C.all_reduce_host(x, group=group)
+    else:
+        y = C.broadcast_host(x, group=group, src=0)
+    return y
+"""
+
+TD002_NEG = """
+def step(x, rank, group):
+    if rank == 0:
+        y = C.scatter_host(x, [x, x], src=0, group=group)
+    else:
+        y = C.scatter_host(x, None, src=0, group=group)
+    return y
+"""
+
+TD003_POS = """
+def publish(store, rank, seq):
+    store.set(f"tpu_dist/coll/ar/{seq}/{rank}", b"1")
+"""
+
+TD003_NEG = """
+def publish(store, rank, seq, gen):
+    store.set(f"tpu_dist/g{gen}/coll/ar/{seq}/{rank}", b"1")
+    store.set(f"tpu_dist/alive/{rank}", b"1")   # documented infra prefix
+"""
+
+TD004_POS = """
+def sync(store, keys, world):
+    store.wait(keys)
+    store.barrier(world, tag="t")
+"""
+
+TD004_NEG = """
+def sync(store, keys, world, cv):
+    store.wait(keys, timeout=30)
+    store.barrier(world, tag="t", timeout=30)
+    cv.wait(0.5)   # single positional IS the timeout on non-store objects
+"""
+
+TD005_POS = """
+import jax, time
+
+@jax.jit
+def step(x):
+    t0 = time.perf_counter()
+    return x * t0
+"""
+
+TD005_NEG = """
+import jax
+
+@jax.jit
+def step(x, key):
+    return x * jax.random.normal(key, x.shape)
+"""
+
+TD006_POS = """
+class T:
+    def a(self):
+        with self._mu:
+            with self._cv:
+                pass
+
+    def b(self):
+        with self._cv:
+            with self._mu:
+                pass
+"""
+
+TD006_NEG = """
+class T:
+    def a(self):
+        with self._mu:
+            with self._cv:
+                pass
+
+    def b(self):
+        with self._mu:
+            with self._cv:
+                pass
+"""
+
+
+class TestRules:
+    @pytest.mark.parametrize("rule,pos,neg", [
+        ("TD001", TD001_POS, TD001_NEG),
+        ("TD002", TD002_POS, TD002_NEG),
+        ("TD003", TD003_POS, TD003_NEG),
+        ("TD004", TD004_POS, TD004_NEG),
+        ("TD005", TD005_POS, TD005_NEG),
+        ("TD006", TD006_POS, TD006_NEG),
+    ])
+    def test_positive_flags_negative_passes(self, rule, pos, neg):
+        assert rule in _rules(lint_source(pos, f"{rule}_pos.py")), \
+            f"{rule} missed its positive fixture"
+        assert _rules(lint_source(neg, f"{rule}_neg.py")) == [], \
+            f"{rule} false-positived on its negative fixture"
+
+    def test_td002_nested_conditional_with_matching_calls_passes(self):
+        # a nested NON-rank conditional whose branches make the same call:
+        # every rank executes exactly one all_reduce — no divergence
+        src = textwrap.dedent("""
+            def step(x, rank, fast, group):
+                if rank == 0:
+                    y = C.all_reduce_host(x, group=group)
+                else:
+                    if fast:
+                        y = C.all_reduce_host(x, group=group)
+                    else:
+                        y = C.all_reduce_host(x, group=group)
+                return y
+        """)
+        assert _rules(lint_source(src, "t.py")) == []
+
+    def test_td006_multi_item_with_records_order(self):
+        # `with a, b:` acquires left to right; opposite nested order in a
+        # sibling function is the same ABBA hazard as two nested withs
+        src = textwrap.dedent("""
+            class T:
+                def a(self):
+                    with self._mu, self._cv:
+                        pass
+
+                def b(self):
+                    with self._cv:
+                        with self._mu:
+                            pass
+        """)
+        assert _rules(lint_source(src, "t.py")) == ["TD006"]
+
+    def test_td001_early_exit_form(self):
+        found = lint_source(TD001_EARLY_EXIT_POS, "early.py")
+        assert _rules(found) == ["TD001"]
+        assert "early exit" in found[0].message
+
+    def test_td001_message_names_collective_and_condition(self):
+        (f,) = lint_source(TD001_POS, "t.py")
+        assert "all_reduce_host" in f.message and "rank == 0" in f.message
+        assert f.severity == "error"
+
+    def test_rule_docs_cover_all_codes(self):
+        assert sorted(RULE_DOCS) == ["TD001", "TD002", "TD003", "TD004",
+                                     "TD005", "TD006"]
+
+    def test_syntax_error_is_td000(self):
+        (f,) = lint_source("def broken(:\n", "bad.py")
+        assert f.rule == "TD000" and f.severity == "error"
+
+
+class TestSuppressions:
+    def test_same_line_suppression(self):
+        src = TD001_POS.replace(
+            "y = C.all_reduce_host(x, group=group)",
+            "y = C.all_reduce_host(x, group=group)  "
+            "# tpudlint: disable=TD001")
+        found = lint_source(src, "t.py")
+        assert _rules(found) == [] and found[0].suppressed
+
+    def test_standalone_comment_covers_next_line(self):
+        src = TD001_POS.replace(
+            "        y = C.all_reduce_host(x, group=group)",
+            "        # tpudlint: disable=TD001  # justified: rank-0 only "
+            "world\n        y = C.all_reduce_host(x, group=group)")
+        found = lint_source(src, "t.py")
+        assert _rules(found) == [] and found[0].suppressed
+
+    def test_stacked_standalone_suppressions_cover_the_code_line(self):
+        # a standalone suppression above ANOTHER standalone suppression
+        # must skip past it and land on the code line, not the comment
+        src = textwrap.dedent("""
+            def sync(store, keys):
+                # tpudlint: disable=TD004  # caller owns the deadline
+                # tpudlint: disable=TD003  # would-be second concern
+                store.wait(keys)
+        """)
+        found = lint_source(src, "t.py")
+        assert [f.rule for f in found] == ["TD004"]
+        assert found[0].suppressed
+
+    def test_suppression_is_rule_specific(self):
+        src = TD001_POS.replace(
+            "y = C.all_reduce_host(x, group=group)",
+            "y = C.all_reduce_host(x, group=group)  "
+            "# tpudlint: disable=TD004")
+        assert _rules(lint_source(src, "t.py")) == ["TD001"]
+
+    def test_disable_all(self):
+        src = TD001_POS.replace(
+            "y = C.all_reduce_host(x, group=group)",
+            "y = C.all_reduce_host(x, group=group)  "
+            "# tpudlint: disable=all")
+        assert _rules(lint_source(src, "t.py")) == []
+
+
+class TestJsonSchema:
+    def test_schema_fields(self):
+        found = lint_source(TD001_POS, "t.py")
+        doc = render_json(found)
+        assert doc["version"] == 1
+        assert set(doc["counts"]) >= {"error", "warning", "suppressed"}
+        assert doc["counts"]["error"] == 1
+        (f,) = doc["findings"]
+        assert set(f) == {"rule", "severity", "path", "line", "col",
+                          "message", "suppressed"}
+        assert f["rule"] == "TD001" and f["path"] == "t.py"
+        json.dumps(doc)  # round-trips
+
+    def test_cli_json_and_exit_codes(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(TD001_POS)
+        env = dict(os.environ, PYTHONPATH=_REPO + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""))
+        r = subprocess.run(
+            [sys.executable, "-m", "tpu_dist.analysis", str(bad),
+             "--format", "json"],
+            capture_output=True, text=True, env=env, cwd=_REPO, timeout=60)
+        assert r.returncode == 1, r.stdout + r.stderr
+        doc = json.loads(r.stdout)
+        assert doc["findings"][0]["rule"] == "TD001"
+        good = tmp_path / "good.py"
+        good.write_text(TD001_NEG)
+        r = subprocess.run(
+            [sys.executable, "-m", "tpu_dist.analysis", str(good)],
+            capture_output=True, text=True, env=env, cwd=_REPO, timeout=60)
+        assert r.returncode == 0, r.stdout + r.stderr
+        r = subprocess.run(
+            [sys.executable, "-m", "tpu_dist.analysis", str(bad),
+             "--fail-on", "never"],
+            capture_output=True, text=True, env=env, cwd=_REPO, timeout=60)
+        assert r.returncode == 0
+
+
+# ---------------------------------------------------------------------------
+# store DELETE_PREFIX (the PR 2 KNOWN-LIMIT reaper the sanitizer and the
+# supervised-restart path both rely on)
+# ---------------------------------------------------------------------------
+
+
+class TestDeletePrefix:
+    @pytest.fixture(params=["native", "python"])
+    def tcp_store(self, request, monkeypatch):
+        from tpu_dist.dist.store import TCPStore, _load_native
+        if request.param == "native" and _load_native() is None:
+            pytest.skip("native toolchain unavailable")
+        if request.param == "python":
+            monkeypatch.setenv("TPU_DIST_PURE_PYTHON_STORE", "1")
+            _load_native.reset()
+        s = TCPStore(is_master=True)
+        yield s
+        s.close()
+        _load_native.reset()
+
+    def test_reaps_generation_keyspace(self, tcp_store):
+        s = tcp_store
+        for k in ("tpu_dist/g0/coll/ar/0/sm", "tpu_dist/g0/dp/addr/1",
+                  "tpu_dist/g0/san/0/0"):
+            s.set(k, b"stale")
+        s.set("tpu_dist/g1/coll/ar/0/sm", b"fresh")
+        s.set("tpu_dist/generation", b"1")
+        assert s.delete_prefix("tpu_dist/g0/") == 3
+        assert s.delete_prefix("tpu_dist/g0/") == 0  # idempotent
+        assert s.check("tpu_dist/g1/coll/ar/0/sm")
+        assert s.check("tpu_dist/generation")
+
+    def test_filestore_delete_prefix(self, tmp_path):
+        from tpu_dist.dist.store import FileStore
+        s = FileStore(str(tmp_path))
+        s.set("tpu_dist/g0/a", b"1")
+        s.set("tpu_dist/g0/b/c", b"2")
+        s.set("tpu_dist/g10/a", b"3")
+        assert s.delete_prefix("tpu_dist/g0/") == 2
+        assert s.check("tpu_dist/g10/a")
+
+
+# ---------------------------------------------------------------------------
+# runtime sanitizer
+# ---------------------------------------------------------------------------
+
+
+class TestSanitizerUnit:
+    def test_disabled_is_default_noop(self, monkeypatch):
+        monkeypatch.delenv("TPU_DIST_SANITIZE", raising=False)
+        from tpu_dist.analysis import sanitizer
+        assert not sanitizer.enabled()
+
+    def test_single_process_noop_even_when_enabled(self, monkeypatch):
+        monkeypatch.setenv("TPU_DIST_SANITIZE", "1")
+        from tpu_dist import collectives as C
+
+        class _G:
+            rank, num_processes = 0, 1
+
+        out = C.all_reduce_host(np.ones(4, np.float32), group=_G())
+        np.testing.assert_array_equal(out, np.ones(4, np.float32))
+
+    def test_eager_gate_parses_like_enabled(self, monkeypatch):
+        # TPU_DIST_SANITIZE=0/false/off must NOT arm the eager hook —
+        # ranks disagreeing on armed-ness would deadline-fail healthy jobs
+        from tpu_dist.collectives import eager
+
+        posted = []
+
+        class _Store:
+            def set(self, k, v):
+                posted.append(k)
+
+            def check(self, k):
+                return False
+
+        class _G:
+            rank, num_processes = 0, 2
+
+        monkeypatch.setenv("TPU_DIST_SANITIZE_TIMEOUT", "0.2")
+        for off in ("0", "false", "off", "", " "):
+            monkeypatch.setenv("TPU_DIST_SANITIZE", off)
+            eager._sanitize("all_reduce", _G(), _Store())
+        assert posted == []
+        monkeypatch.setenv("TPU_DIST_SANITIZE", "1")
+        from tpu_dist.analysis import CollectiveMismatchError
+        with pytest.raises(CollectiveMismatchError, match="never announced"):
+            eager._sanitize("all_reduce", _G(), _Store())
+        assert posted  # the armed path published a signature
+
+    def test_signature_captures_semantics(self):
+        from tpu_dist.analysis import sanitizer
+        sig = sanitizer._signature(
+            "all_reduce", 0, value={"w": np.zeros((2, 3), np.float32)},
+            reduce_op="SUM")
+        assert sig["op"] == "all_reduce" and sig["reduce"] == "sum"
+        assert sig["leaves"] == [["float32", [2, 3]]]
+        assert "tree" in sig and ":" in sig["site"]
+
+
+_SAN_PRELUDE = textwrap.dedent("""
+    import importlib, json, os, sys
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    rank = int(os.environ["RANK"]); world = int(os.environ["WORLD_SIZE"])
+    from tpu_dist.dist.store import TCPStore
+    host, _, port = os.environ["TPU_DIST_STORE_ADDR"].rpartition(":")
+    store = TCPStore(host, int(port))
+    rdzv = importlib.import_module("tpu_dist.dist.rendezvous")
+    rdzv._store = store
+
+    class _Group:
+        def __init__(self, rank, num_processes):
+            self.rank, self.num_processes = rank, num_processes
+    g = _Group(rank, world)
+    from tpu_dist import collectives as C
+    from tpu_dist.analysis import CollectiveMismatchError
+
+    def finish(payload):
+        with open(sys.argv[1] + f"/result{rank}.json", "w") as f:
+            json.dump(payload, f)
+        store.close()
+        sys.exit(0)
+""")
+
+# rank 1 calls a DIFFERENT collective than rank 0 at the same point in the
+# program: the sanitizer must convert the would-be deadlock into a named
+# error on EVERY rank, before any payload moves
+_SAN_MISMATCH_WORKER = _SAN_PRELUDE + textwrap.dedent("""
+    x = np.ones(256, np.float32)
+    try:
+        if rank == 0:  # tpudlint: disable=TD002  # the bug under test
+            C.all_reduce_host(x, group=g, op="sum")
+        else:
+            C.broadcast_host(x, group=g, src=0)
+        finish({"error": None})
+    except CollectiveMismatchError as e:
+        finish({"error": "CollectiveMismatchError", "message": str(e),
+                "divergent": sorted(e.divergent), "seq": e.seq})
+""")
+
+# rank 1 never calls ANY collective (the `if rank == 0: all_reduce` bug):
+# rank 0 must fail within the deadline instead of hanging
+_SAN_MISSING_WORKER = _SAN_PRELUDE + textwrap.dedent("""
+    import time
+    x = np.ones(256, np.float32)
+    if rank == 1:
+        time.sleep(8)   # outlive rank 0's deadline without participating
+        finish({"error": None})
+    t0 = time.monotonic()
+    try:
+        C.all_reduce_host(x, group=g, op="sum")  # tpudlint: disable=all
+        finish({"error": None})
+    except CollectiveMismatchError as e:
+        finish({"error": "CollectiveMismatchError", "message": str(e),
+                "missing": e.missing,
+                "elapsed": round(time.monotonic() - t0, 2)})
+""")
+
+# matched collectives must pass the check and produce correct numbers
+_SAN_CLEAN_WORKER = _SAN_PRELUDE + textwrap.dedent("""
+    x = np.full(256, float(rank + 1), np.float32)
+    out = C.all_reduce_host(x, group=g, op="sum")
+    total = sum(r + 1 for r in range(world))
+    np.testing.assert_allclose(out, np.full(256, total, np.float32))
+    bc = C.broadcast_host(x, group=g, src=0)
+    np.testing.assert_allclose(bc, np.full(256, 1.0, np.float32))
+    store.barrier(world, tag="done", timeout=60)
+    finish({"error": None})
+""")
+
+
+def _spawn_sanitized(tmp_path, source, world=2, timeout=120, extra_env=None):
+    from tpu_dist.dist.store import TCPStore
+    script = tmp_path / "worker.py"
+    script.write_text(source)
+    server = TCPStore(is_master=True)
+    env = dict(os.environ,
+               PYTHONPATH=_REPO + os.pathsep + os.environ.get("PYTHONPATH",
+                                                              ""),
+               JAX_PLATFORMS="cpu",
+               TPU_DIST_STORE_ADDR=f"127.0.0.1:{server.port}",
+               WORLD_SIZE=str(world),
+               TPU_DIST_SANITIZE="1",
+               TPU_DIST_SANITIZE_TIMEOUT="4",
+               **(extra_env or {}))
+    env.pop("TPU_DIST_RESTART_COUNT", None)
+    try:
+        procs = [subprocess.Popen(
+            [sys.executable, str(script), str(tmp_path)],
+            env=dict(env, RANK=str(r)), cwd=_REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+            for r in range(world)]
+        outs = [p.communicate(timeout=timeout) for p in procs]
+        rcs = [p.returncode for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        server.close()
+    assert rcs == [0] * world, "\n\n".join(
+        f"rank {r} rc={rc}\nstdout:\n{o}\nstderr:\n{e}"
+        for r, (rc, (o, e)) in enumerate(zip(rcs, outs)) if rc != 0)
+    return [json.loads((tmp_path / f"result{r}.json").read_text())
+            for r in range(world)]
+
+
+@pytest.mark.multiprocess
+class TestSanitizerE2E:
+    def test_mismatched_collective_fails_every_rank_named(self, tmp_path):
+        res = _spawn_sanitized(tmp_path, _SAN_MISMATCH_WORKER)
+        for r, out in enumerate(res):
+            assert out["error"] == "CollectiveMismatchError", (r, out)
+            # names the culprit call-site (the worker script, its line)
+            assert "worker.py:" in out["message"], out["message"]
+            assert "rank" in out["message"]
+            assert out["seq"] == 0
+        # each rank reports the OTHER side as divergent from its majority
+        assert any("all_reduce" in out["message"]
+                   and "broadcast" in out["message"] for out in res)
+
+    def test_missing_rank_fails_within_deadline_not_hang(self, tmp_path):
+        res = _spawn_sanitized(tmp_path, _SAN_MISSING_WORKER)
+        out = res[0]
+        assert out["error"] == "CollectiveMismatchError"
+        assert out["missing"] == [1]
+        assert "rank(s) [1] never announced" in out["message"]
+        assert "worker.py:" in out["message"]
+        assert out["elapsed"] < 30   # deadline (4s) + slack, NOT a hang
+        assert res[1]["error"] is None
+
+    def test_matched_collectives_pass_clean(self, tmp_path):
+        res = _spawn_sanitized(tmp_path, _SAN_CLEAN_WORKER)
+        assert all(out["error"] is None for out in res)

@@ -17,15 +17,17 @@ TPU.  Currently shipped subpackages:
   ring/Ulysses sequence parallel, MoE expert-parallel rules
 - ``tpu_dist.checkpoint`` — atomic step-numbered save/restore (sharded ok)
 - ``tpu_dist.resilience`` — heartbeat watchdog, auto-resume, chaos faults
+- ``tpu_dist.analysis`` — tpudlint static checker + runtime collective
+  sanitizer (distributed-correctness tooling)
 - ``tpu_dist.utils`` — rank-0 logging, metric windows, profiling
 - ``tpu_dist.ops`` — Pallas TPU kernels (fused CE, flash attention)
 """
 
 __version__ = "0.1.0"
 
-from . import (checkpoint, collectives, data, dist, interop, models, nn,
-               optim, parallel, resilience, utils)
+from . import (analysis, checkpoint, collectives, data, dist, interop,
+               models, nn, optim, parallel, resilience, utils)
 
 __all__ = ["nn", "optim", "models", "dist", "collectives", "data",
            "parallel", "checkpoint", "resilience", "utils", "interop",
-           "__version__"]
+           "analysis", "__version__"]

@@ -1,0 +1,32 @@
+"""tpu_dist.analysis — distributed-correctness tooling.
+
+Two halves (docs/analysis.md):
+
+- **tpudlint**, a static AST pass over tpu_dist programs
+  (``python -m tpu_dist.analysis <paths>``): six rule classes (TD001–TD006)
+  for the hazards that silently deadlock an eager-SPMD world — collectives
+  under rank conditionals, divergent collective sequences, un-namespaced
+  store keys, deadline-less blocking waits, host side effects under
+  ``jax.jit``, inconsistent lock order.  ``# tpudlint: disable=TDnnn``
+  suppressions, text/JSON output, CI-friendly exit codes.
+- a **runtime sanitizer** (``TPU_DIST_SANITIZE=1`` or ``tpu_dist.launch
+  --sanitize``): every eager host collective cross-checks a per-call
+  signature (op, tree structure, dtypes/shapes, call-site) across ranks
+  through the generation-scoped store before executing, raising
+  :class:`CollectiveMismatchError` naming the divergent rank and call-site
+  within a bounded deadline instead of hanging.
+
+veScale's argument (PAPERS.md) is that eager-mode SPMD needs consistency
+*checking*, not just consistent primitives; Launchpad's is that a
+program-level representation enables tooling.  tpudlint is the
+program-level half, the sanitizer the runtime half.
+"""
+
+from .findings import Finding, render_json, render_text
+from .linter import lint_file, lint_paths, lint_source
+from .rules import RULE_DOCS, RULES
+from .sanitizer import CollectiveMismatchError, check_collective, enabled
+
+__all__ = ["Finding", "lint_source", "lint_file", "lint_paths",
+           "render_text", "render_json", "RULES", "RULE_DOCS",
+           "CollectiveMismatchError", "check_collective", "enabled"]

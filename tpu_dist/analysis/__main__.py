@@ -1,0 +1,94 @@
+"""``python -m tpu_dist.analysis <paths>`` — the tpudlint CLI.
+
+Exit codes: 0 = clean (no unsuppressed finding at/above ``--fail-on``),
+1 = findings, 2 = usage error.  ``--format json`` emits the schema in
+tpu_dist/analysis/findings.py; text is ``path:line:col: TDnnn [sev] msg``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from .findings import SEVERITY_ORDER, render_json, render_text
+from .linter import lint_paths
+from .rules import RULE_DOCS
+
+
+def _default_paths() -> List[str]:
+    """``tpu_dist`` + ``examples``, resolved against the CWD first and the
+    repo/package root second — so the documented bare invocation works
+    from any directory instead of emitting TD000 read errors."""
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    out = []
+    for name in ("tpu_dist", "examples"):
+        if os.path.exists(name):
+            out.append(name)
+        elif os.path.exists(os.path.join(root, name)):
+            out.append(os.path.join(root, name))
+    return out
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tpu_dist.analysis",
+        description="tpudlint: distributed-correctness linter for tpu_dist "
+                    "programs (rank-divergent collectives, un-namespaced "
+                    "store keys, deadline-less waits, host effects under "
+                    "jit, lock-order cycles).")
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files or directories to lint (default: the "
+                        "repo's tpu_dist + examples dirs, resolved "
+                        "against the CWD and then the installed package "
+                        "root)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--rules", type=str, default=None,
+                   help="comma-separated rule codes to run (default: all)")
+    p.add_argument("--fail-on", choices=("warning", "error", "never"),
+                   default="warning",
+                   help="minimum unsuppressed severity that makes the exit "
+                        "code non-zero (default: warning, i.e. any finding)")
+    p.add_argument("--show-suppressed", action="store_true",
+                   help="include suppressed findings in text output")
+    p.add_argument("--list-rules", action="store_true")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for code in sorted(RULE_DOCS):
+            print(f"{code}  {RULE_DOCS[code]}")
+        return 0
+    paths = args.paths or _default_paths()
+    if not paths:
+        sys.stderr.write("no paths given and no tpu_dist/examples dirs "
+                         "found near the CWD or package root\n")
+        return 2
+    rules = ([r.strip().upper() for r in args.rules.split(",") if r.strip()]
+             if args.rules else None)
+    if rules:
+        unknown = [r for r in rules if r not in RULE_DOCS and r != "TD000"]
+        if unknown:
+            sys.stderr.write(f"unknown rule(s): {', '.join(unknown)} "
+                             f"(see --list-rules)\n")
+            return 2
+    findings = lint_paths(paths, rules=rules)
+    if args.format == "json":
+        print(json.dumps(render_json(findings), indent=2))
+    else:
+        print(render_text(findings, show_suppressed=args.show_suppressed))
+    if args.fail_on == "never":
+        return 0
+    threshold = SEVERITY_ORDER[args.fail_on]
+    worst = max((SEVERITY_ORDER[f.severity] for f in findings
+                 if not f.suppressed), default=0)
+    return 1 if worst >= threshold else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

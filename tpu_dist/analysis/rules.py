@@ -1,0 +1,550 @@
+"""``tpudlint`` rule implementations — AST passes over one module.
+
+Each rule is a function ``(tree, path) -> [Finding]`` registered in
+:data:`RULES`.  The rules encode the distributed-correctness hazards this
+framework's own layers are exposed to (see docs/analysis.md for a
+deadlocking example per rule):
+
+- **TD001** — collective call inside a rank-conditional branch (classic
+  ``if rank == 0: all_reduce(...)``): ranks taking the other branch never
+  enter the collective, the participating ranks block forever.  Also fires
+  on collectives *after* a rank-conditional early return.
+- **TD002** — both branches of a rank-conditional call collectives, but
+  different *sequences* of them: ranks pair a ring all-reduce against a
+  broadcast and both sides hang (or worse, mis-match payloads).
+- **TD003** — raw ``tpu_dist/...`` control-plane store key that is not
+  namespaced by gang generation (``tpu_dist/g{gen}/...``) and is not one
+  of the known cross-generation infrastructure prefixes.  Stale keys a
+  crashed incarnation left behind would collide with a restarted
+  incarnation's fresh sequence counters.
+- **TD004** — blocking store/queue/socket wait without a deadline: a dead
+  peer turns the call into an infinite hang the supervisor cannot name.
+- **TD005** — host side effects (store ops, host collectives, ``time``,
+  ``random``) inside ``jax.jit``/``pjit``-traced functions: they run at
+  trace time, once, not per step — silently wrong, and rank-divergent
+  tracing deadlocks the compile barrier.
+- **TD006** — inconsistent lock-acquisition order inside one module (lock
+  A taken under B in one place, B under A in another): the ABBA deadlock
+  pattern for transport-style modules full of fine-grained locks.
+
+Heuristics are deliberately name-based (``rank``-ish identifiers,
+``*_host`` collectives, ``_mu``/``_lock``/``_cv`` locks): this linter
+checks *this* codebase's conventions, the same way PR 2 fixed the key
+namespace by convention.  False positives are expected to be silenced with
+a justified ``# tpudlint: disable=TDnnn`` (findings.py).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .findings import Finding
+
+__all__ = ["RULES", "RULE_DOCS", "run_rules",
+           "COLLECTIVE_CALLS", "RANK_NAMES"]
+
+# -- shared vocabulary --------------------------------------------------------
+
+# identifiers whose value is (a function of) this process's rank
+RANK_NAMES = frozenset({
+    "rank", "local_rank", "node_rank", "global_rank", "process_id",
+    "process_index", "proc_id", "worker_rank",
+})
+RANK_CALLS = frozenset({
+    "get_rank", "get_local_rank", "process_index", "get_process_index",
+})
+
+# blocking cross-rank collectives: every rank of the group must call these
+# the same number of times in the same order.  Point-to-point send/recv are
+# rank-asymmetric BY DESIGN and deliberately absent.
+COLLECTIVE_CALLS = frozenset({
+    "all_reduce_host", "all_gather_host", "broadcast_host", "reduce_host",
+    "gather_host", "scatter_host", "all_to_all_host",
+    "all_gather_object", "gather_object", "broadcast_object_list",
+    "scatter_object_list",
+    "barrier", "monitored_barrier",
+    "ring_all_reduce", "ring_all_gather", "ring_reduce_scatter",
+    "tree_broadcast",
+})
+
+# blocking waits that need a deadline (TD004); per-method positional index
+# (0-based) at which a timeout may legally arrive positionally
+_WAIT_METHODS: Dict[str, int] = {
+    "wait": 1,             # store.wait(keys, timeout)
+    "wait_value_ge": 2,    # store.wait_value_ge(key, target, timeout)
+    "wait_ge": 2,
+    "barrier": 2,          # store.barrier(world, tag, timeout)
+    "monitored_barrier": 2,
+    "recv_array": 2,       # dp.recv_array(src, tag, timeout)
+}
+_TIMEOUT_KWARGS = frozenset({"timeout", "deadline", "timeout_s"})
+
+# cross-generation infrastructure keys that legitimately live OUTSIDE the
+# g{gen} namespace (bootstrap/liveness/supervisor agreement — written and
+# reaped by the launcher itself, see docs/analysis.md#td003)
+TD003_ALLOWED_PREFIXES = (
+    "tpu_dist/alive",       # pre-rendezvous liveness (reset every round)
+    "tpu_dist/generation",  # THE generation fence key itself
+    "tpu_dist/master_port", # coordinator port negotiation (pre-generation)
+    "tpu_dist/elastic",     # launcher restart agreement (round-scoped keys)
+    "tpu_dist/hb",          # heartbeats (generation-scoped by path segment)
+    "tpu_dist/g",           # already in the generation namespace
+)
+
+_LOCK_SUFFIXES = ("_mu", "_lock", "_cv", "_cond", "_mutex")
+_LOCK_EXACT = frozenset({"mu", "lock", "cv", "cond", "mutex", "lk"})
+
+
+def _terminal_name(func: ast.AST) -> Optional[str]:
+    """The final identifier of a call target: ``C.all_reduce_host`` ->
+    ``all_reduce_host``; ``barrier`` -> ``barrier``."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """Dotted path of a Name/Attribute chain (``self._out_mu`` ->
+    ``self._out_mu``), or None for non-trivial expressions."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _mentions_rank(expr: ast.AST) -> bool:
+    """True when the expression reads a rank-ish identifier or calls a
+    rank accessor — the test of a rank-conditional branch."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and node.id in RANK_NAMES:
+            return True
+        if isinstance(node, ast.Attribute) and node.attr in RANK_NAMES:
+            return True
+        if isinstance(node, ast.Call):
+            name = _terminal_name(node.func)
+            if name in RANK_CALLS:
+                return True
+    return False
+
+
+def _collective_sequence(stmts: Sequence[ast.stmt]) -> List[ast.Call]:
+    """All collective Call nodes in the statements' subtrees, in source
+    order (the *sequence* every rank must agree on)."""
+    calls = []
+    for stmt in stmts:
+        for node in ast.walk(stmt):
+            if (isinstance(node, ast.Call)
+                    and _terminal_name(node.func) in COLLECTIVE_CALLS):
+                calls.append(node)
+    calls.sort(key=lambda c: (c.lineno, c.col_offset))
+    return calls
+
+
+def _canonical_names(stmts: Sequence[ast.stmt]) -> List[str]:
+    """Collective-call name sequence a rank EXECUTES through these
+    statements: a nested conditional whose branches contribute identical
+    sequences counts once (either path makes the same calls), so
+    `if fast: all_reduce(...) else: all_reduce(...)` is one call, not
+    two.  Divergent nested branches are flattened — a nested *rank*
+    conditional gets its own TD001/TD002 visit anyway."""
+    out: List[str] = []
+    for stmt in stmts:
+        out.extend(_canonical_names_node(stmt))
+    return out
+
+
+def _canonical_names_node(node: ast.AST) -> List[str]:
+    if isinstance(node, ast.If):
+        test = _canonical_names_node(node.test)
+        body = _canonical_names(node.body)
+        orelse = _canonical_names(node.orelse)
+        return test + (body if body == orelse else body + orelse)
+    out: List[str] = []
+    for child in ast.iter_child_nodes(node):
+        out.extend(_canonical_names_node(child))
+    if isinstance(node, ast.Call):
+        name = _terminal_name(node.func)
+        if name in COLLECTIVE_CALLS:
+            out.append(name)  # after children: argument-evaluation order
+    return out
+
+
+def _src(node: ast.AST, limit: int = 60) -> str:
+    try:
+        text = ast.unparse(node)
+    except Exception:
+        return "<expr>"
+    return text if len(text) <= limit else text[:limit - 3] + "..."
+
+
+def _branch_terminates(stmts: Sequence[ast.stmt]) -> bool:
+    """True when the branch unconditionally leaves the enclosing block
+    (return/raise/continue/break as a top-level statement)."""
+    return any(isinstance(s, (ast.Return, ast.Raise, ast.Continue,
+                              ast.Break)) for s in stmts)
+
+
+# -- TD001 / TD002: rank-divergent collectives --------------------------------
+
+
+def _check_rank_if(test: ast.expr, body: Sequence[ast.stmt],
+                   orelse: Sequence[ast.stmt], path: str,
+                   out: List[Finding]) -> None:
+    # canonical sequences decide consistency (nested same-on-both-sides
+    # conditionals count once); raw Call nodes locate the TD001 findings
+    names_body = _canonical_names(body)
+    names_else = _canonical_names(orelse)
+    if names_body == names_else:
+        return  # both sides run the same collective sequence: consistent
+    seq_body = _collective_sequence(body)
+    seq_else = _collective_sequence(orelse)
+    if names_body and names_else:
+        out.append(Finding(
+            "TD002", "error", path, test.lineno, test.col_offset,
+            f"branches of rank-conditional `if {_src(test)}` call divergent "
+            f"collective sequences ({names_body} vs {names_else}); ranks "
+            f"taking different branches enter mismatched collectives and "
+            f"deadlock"))
+        return
+    for call in (seq_body or seq_else):
+        out.append(Finding(
+            "TD001", "error", path, call.lineno, call.col_offset,
+            f"collective {_terminal_name(call.func)}() inside "
+            f"rank-conditional branch (`if {_src(test)}`): ranks taking "
+            f"the other branch never enter it — the group deadlocks"))
+
+
+def rule_td001_td002(tree: ast.AST, path: str) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.If) and _mentions_rank(node.test):
+            _check_rank_if(node.test, node.body, node.orelse, path, out)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.For, ast.While, ast.With)):
+            # rank-conditional EARLY RETURN: `if rank != 0: return` followed
+            # by collectives — the remaining ranks block in them forever
+            _check_early_exit(node.body, path, out)
+    return out
+
+
+def _check_early_exit(stmts: Sequence[ast.stmt], path: str,
+                      out: List[Finding]) -> None:
+    for i, stmt in enumerate(stmts):
+        if (isinstance(stmt, ast.If) and _mentions_rank(stmt.test)
+                and not stmt.orelse and _branch_terminates(stmt.body)
+                and not _collective_sequence(stmt.body)):
+            for call in _collective_sequence(stmts[i + 1:]):
+                out.append(Finding(
+                    "TD001", "error", path, call.lineno, call.col_offset,
+                    f"collective {_terminal_name(call.func)}() is only "
+                    f"reached by ranks that pass the rank-conditional "
+                    f"early exit at line {stmt.lineno} "
+                    f"(`if {_src(stmt.test)}`): the exiting ranks never "
+                    f"join it — the group deadlocks"))
+            return  # one diagnosis per block; nested blocks walk separately
+
+
+# -- TD003: un-namespaced store keys ------------------------------------------
+
+
+def _key_literal_prefix(node: ast.AST) -> Optional[Tuple[str, bool]]:
+    """``(literal_prefix, generation_namespaced)`` for a string constant or
+    f-string, or None for other expressions.  ``generation_namespaced`` is
+    True when the first path segment after ``tpu_dist/`` is ``g`` + an
+    interpolated value or digits (the ``tpu_dist/g{gen}/...`` shape)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        text = node.value
+    elif isinstance(node, ast.JoinedStr):
+        first = node.values[0] if node.values else None
+        if not (isinstance(first, ast.Constant)
+                and isinstance(first.value, str)):
+            return None
+        text = first.value
+        # "tpu_dist/g{rdzv.generation()}/..." — the literal head ends right
+        # at "g" and the interpolation supplies the generation number
+        if text.startswith("tpu_dist/g") and len(node.values) > 1:
+            rest = text[len("tpu_dist/g"):]
+            if rest == "" or rest.isdigit():
+                return text, True
+    else:
+        return None
+    # tpudlint: disable=TD003  # prefix literals of the rule itself
+    if not text.startswith("tpu_dist/"):
+        return None
+    seg = text[len("tpu_dist/"):].split("/", 1)[0]  # tpudlint: disable=TD003  # ditto
+    namespaced = seg.startswith("g") and seg[1:].isdigit() and len(seg) > 1
+    return text, namespaced
+
+
+def _is_docstring_position(parents: Dict[ast.AST, ast.AST],
+                           node: ast.AST) -> bool:
+    parent = parents.get(node)
+    return isinstance(parent, ast.Expr)
+
+
+def rule_td003(tree: ast.AST, path: str) -> List[Finding]:
+    out: List[Finding] = []
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    seen_joined: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.JoinedStr):
+            for v in node.values:
+                seen_joined.add(id(v))
+        lit = _key_literal_prefix(node)
+        if lit is None or id(node) in seen_joined:
+            continue
+        if _is_docstring_position(parents, node):
+            continue  # docstrings routinely NAME keys; they don't mint them
+        text, namespaced = lit
+        if namespaced:
+            continue
+        if any(text == p or text.startswith(p + "/")
+               for p in TD003_ALLOWED_PREFIXES):
+            continue
+        out.append(Finding(
+            "TD003", "error", path, node.lineno, node.col_offset,
+            f"raw store key {text!r} is not namespaced by gang generation: "
+            f"route it through the generation helper "
+            f"(tpu_dist/g{{gen}}/..., see "
+            f"tpu_dist.collectives.eager._ns) or a documented "
+            f"cross-generation prefix — stale keys from a crashed "
+            f"incarnation otherwise collide with the restarted one"))
+    return out
+
+
+# -- TD004: deadline-less blocking waits --------------------------------------
+
+
+def _has_timeout(call: ast.Call, method: str) -> bool:
+    for kw in call.keywords:
+        if kw.arg in _TIMEOUT_KWARGS:
+            return True
+    pos_idx = _WAIT_METHODS[method]
+    return len(call.args) > pos_idx
+
+
+def rule_td004(tree: ast.AST, path: str) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _terminal_name(node.func)
+        if name not in _WAIT_METHODS or not isinstance(node.func,
+                                                       ast.Attribute):
+            continue
+        if _has_timeout(node, name):
+            continue
+        recv = _dotted(node.func.value) or "<expr>"
+        if name == "wait" and len(node.args) == 1 \
+                and "store" not in recv.lower():
+            # cv.wait(t) / event.wait(t): the single positional IS the
+            # timeout; only store.wait(keys) takes keys first
+            continue
+        out.append(Finding(
+            "TD004", "warning", path, node.lineno, node.col_offset,
+            f"blocking {recv}.{name}(...) without a timeout/deadline "
+            f"argument: a dead peer turns this into an unbounded hang the "
+            f"supervisor cannot diagnose — pass timeout= (or suppress with "
+            f"a justification if an internal default deadline applies)"))
+    return out
+
+
+# -- TD005: host side effects under jit ---------------------------------------
+
+
+_JIT_NAMES = frozenset({"jit", "pjit", "pmap"})
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """True for ``jax.jit`` / ``pjit`` / ``partial(jax.jit, ...)`` /
+    ``jax.jit(...)`` decorator expressions."""
+    name = _terminal_name(node) if isinstance(node, (ast.Name,
+                                                     ast.Attribute)) else None
+    if name in _JIT_NAMES:
+        return True
+    if isinstance(node, ast.Call):
+        fname = _terminal_name(node.func)
+        if fname in _JIT_NAMES:
+            return True
+        if fname == "partial" and node.args \
+                and _terminal_name(node.args[0]) in _JIT_NAMES:
+            return True
+    return False
+
+
+_TIME_FUNCS = frozenset({"time", "sleep", "perf_counter", "monotonic",
+                         "time_ns", "perf_counter_ns", "monotonic_ns"})
+_STORE_OPS = frozenset({"set", "get", "add", "check", "delete_key",
+                        "delete_prefix", "wait", "wait_value_ge", "barrier",
+                        "num_keys"})
+
+
+def _td005_offense(call: ast.Call) -> Optional[str]:
+    name = _terminal_name(call.func)
+    dotted = _dotted(call.func) or name or ""
+    root = dotted.split(".", 1)[0]
+    if root == "time" and name in _TIME_FUNCS:
+        return f"wall-clock call {dotted}()"
+    if root == "random" or dotted.startswith(("np.random.", "numpy.random.")):
+        return f"host RNG call {dotted}() (use jax.random with a key)"
+    if name in COLLECTIVE_CALLS:
+        return f"host collective {name}()"
+    if name in _STORE_OPS and isinstance(call.func, ast.Attribute):
+        recv = (_dotted(call.func.value) or "").lower()
+        if "store" in recv:
+            return f"control-plane store op {dotted}()"
+    return None
+
+
+def _jitted_functions(tree: ast.AST):
+    """FunctionDefs that are jit-traced: decorated with a jit expression,
+    or referenced by a ``jax.jit(fn)`` call in the same module."""
+    by_name: Dict[str, ast.AST] = {}
+    jitted = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(node.name, node)
+            if any(_is_jit_expr(d) for d in node.decorator_list):
+                jitted.append(node)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) \
+                and _terminal_name(node.func) in _JIT_NAMES:
+            for arg in node.args:
+                target = _terminal_name(arg) if isinstance(
+                    arg, (ast.Name, ast.Attribute)) else None
+                fn = by_name.get(target or "")
+                if fn is not None and fn not in jitted:
+                    jitted.append(fn)
+    return jitted
+
+
+def rule_td005(tree: ast.AST, path: str) -> List[Finding]:
+    out: List[Finding] = []
+    for fn in _jitted_functions(tree):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            offense = _td005_offense(node)
+            if offense:
+                out.append(Finding(
+                    "TD005", "error", path, node.lineno, node.col_offset,
+                    f"{offense} inside jit-traced function "
+                    f"`{fn.name}`: runs once at trace time (not per step) "
+                    f"and may diverge across ranks during compilation"))
+    return out
+
+
+# -- TD006: lock-acquisition order --------------------------------------------
+
+
+def _lock_name(expr: ast.AST) -> Optional[str]:
+    dotted = _dotted(expr)
+    if dotted is None:
+        return None
+    last = dotted.rsplit(".", 1)[-1].lower()
+    if last in _LOCK_EXACT or last.endswith(_LOCK_SUFFIXES):
+        return dotted
+    return None
+
+
+class _LockOrderVisitor(ast.NodeVisitor):
+    """Collects (outer, inner) lock-nesting edges from `with` blocks.
+
+    Per-function lock stacks (a `with` in one function does not cover a
+    nested function's body at runtime), aggregated module-wide — two
+    functions disagreeing on order is exactly the ABBA hazard."""
+
+    def __init__(self):
+        self.edges: Dict[Tuple[str, str], Tuple[int, int]] = {}
+        self._stack: List[str] = []
+
+    def _visit_scope(self, node):
+        saved, self._stack = self._stack, []
+        self.generic_visit(node)
+        self._stack = saved
+
+    visit_FunctionDef = _visit_scope
+    visit_AsyncFunctionDef = _visit_scope
+    visit_Lambda = _visit_scope
+
+    def visit_With(self, node: ast.With):
+        names = []
+        for item in node.items:
+            ctx = item.context_expr
+            if isinstance(ctx, ast.Call):
+                ctx = ctx.func  # with self._out_lock(dst): -> _out_lock
+            name = _lock_name(ctx)
+            if name:
+                names.append(name)
+        for i, name in enumerate(names):
+            # `with a, b:` acquires left to right — the earlier items of
+            # the same statement are held when the later ones are taken
+            for held in self._stack + names[:i]:
+                if held != name:
+                    self.edges.setdefault(
+                        (held, name), (node.lineno, node.col_offset))
+        self._stack.extend(names)
+        self.generic_visit(node)
+        del self._stack[len(self._stack) - len(names):]
+
+
+def rule_td006(tree: ast.AST, path: str) -> List[Finding]:
+    v = _LockOrderVisitor()
+    v.visit(tree)
+    out: List[Finding] = []
+    reported = set()
+    for (a, b), (line, col) in sorted(v.edges.items(),
+                                      key=lambda kv: kv[1]):
+        if (b, a) in v.edges and frozenset((a, b)) not in reported:
+            reported.add(frozenset((a, b)))
+            other_line = v.edges[(b, a)][0]
+            first, second = ((a, b, line), (b, a, other_line))
+            if other_line < line:
+                first, second = second, first
+            out.append(Finding(
+                "TD006", "warning", path, second[2], col,
+                f"inconsistent lock order: {second[0]} -> {second[1]} "
+                f"here, but {first[0]} -> {first[1]} at line {first[2]} — "
+                f"two threads taking the locks in opposite order deadlock "
+                f"(ABBA)"))
+    return out
+
+
+# -- registry -----------------------------------------------------------------
+
+RULES = {
+    "TD001": rule_td001_td002,   # emits TD001 and TD002
+    "TD003": rule_td003,
+    "TD004": rule_td004,
+    "TD005": rule_td005,
+    "TD006": rule_td006,
+}
+
+RULE_DOCS = {
+    "TD001": "collective call inside a rank-conditional branch",
+    "TD002": "divergent collective sequences across rank-conditional "
+             "branches",
+    "TD003": "raw control-plane store key not namespaced by generation",
+    "TD004": "blocking store/socket/queue wait without a deadline",
+    "TD005": "host side effects (store/collectives/time/random) inside "
+             "jit-traced functions",
+    "TD006": "inconsistent lock-acquisition order within a module",
+}
+
+
+def run_rules(tree: ast.AST, path: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for fn in RULES.values():
+        findings.extend(fn(tree, path))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

@@ -1,0 +1,219 @@
+"""Runtime cross-rank collective sanitizer (``TPU_DIST_SANITIZE=1``).
+
+The static pass (tpudlint) catches rank-divergent collectives it can *see*;
+this is the runtime complement for the ones it cannot (divergence through
+data, config skew, library code).  When enabled, every eager host
+collective first publishes a per-call **signature** to the
+generation-scoped control-plane store and cross-checks agreement across
+ranks before any payload moves:
+
+    {ns}/san/{seq}/{rank}  ->  {"op": "all_reduce", "reduce": "sum",
+                               "tree": "<structure hash>",
+                               "leaves": [["float32", [1024]], ...],
+                               "src"/"dst": ...,
+                               "site": "train.py:123", "rank": 2}
+
+``seq`` is a process-local counter: in an SPMD program every rank arrives
+at sanitized collective #seq together, so the keys line up.  Each rank
+waits (bounded by ``TPU_DIST_SANITIZE_TIMEOUT``, default 30 s) for every
+peer's signature, then compares the *semantic* fields (op, reduce op,
+tree structure, leaf dtypes/shapes, root rank — everything that must be
+uniform for the collective to be well-formed).  Divergence raises
+:class:`CollectiveMismatchError` on **every** rank, naming the divergent
+rank(s), their call-sites, and the first differing field — a named error
+at first occurrence instead of a silent hang.  A rank that never announces
+(the ``if rank == 0: all_reduce(...)`` bug: the other ranks never reach a
+collective at all) surfaces as the same error via the deadline.
+
+Cost model: one store SET + one bounded poll per peer per collective —
+strictly control-plane traffic, so it rides the same server the small-leaf
+path already uses.  Off (the default), the only cost is one environment
+lookup per collective call (measured ≤ 1 µs; the acceptance bound is ≤ 5%
+on ``benchmarks/bench_host_collectives.py --smoke``).
+
+Call-site attribution walks the stack to the first frame outside
+``tpu_dist/collectives`` and ``tpu_dist/analysis``, so the error names the
+user's line, not the framework's.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = ["CollectiveMismatchError", "enabled", "check_collective",
+           "reset", "SEMANTIC_FIELDS"]
+
+# fields that must agree across ranks (compared); "site"/"rank" are
+# diagnostic only — the same collective may legitimately be reached from
+# different lines (e.g. matching calls in both branches of a conditional)
+SEMANTIC_FIELDS = ("op", "reduce", "tree", "leaves", "src", "dst")
+
+_seq = 0  # process-local sanitized-collective counter
+
+
+class CollectiveMismatchError(RuntimeError):
+    """Ranks disagreed on (or never announced) a host collective.
+
+    Attributes: ``rank`` (this process), ``seq`` (sanitized-call index),
+    ``op``, ``site`` (this rank's call-site), ``divergent`` (rank ->
+    signature dict for the disagreeing ranks, empty on a timeout),
+    ``missing`` (ranks that never announced, empty on a mismatch)."""
+
+    def __init__(self, rank: int, seq: int, op: str, site: str,
+                 message: str, divergent: Optional[Dict[int, Dict]] = None,
+                 missing: Optional[List[int]] = None):
+        self.rank, self.seq, self.op, self.site = rank, seq, op, site
+        self.divergent = divergent or {}
+        self.missing = missing or []
+        super().__init__(message)
+
+
+def enabled() -> bool:
+    return os.environ.get("TPU_DIST_SANITIZE", "").strip().lower() in (
+        "1", "true", "yes", "on")
+
+
+def _timeout() -> float:
+    try:
+        return float(os.environ.get("TPU_DIST_SANITIZE_TIMEOUT", "30"))
+    except ValueError:
+        return 30.0
+
+
+def reset() -> None:
+    """Restart the sanitized-call counter (tests / re-init)."""
+    global _seq
+    _seq = 0
+
+
+def _call_site() -> str:
+    """First stack frame outside the collectives/analysis machinery."""
+    import inspect
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    skip = (os.path.join(here, "collectives"), os.path.join(here, "analysis"))
+    frame = inspect.currentframe()
+    try:
+        while frame is not None:
+            fname = frame.f_code.co_filename
+            if not fname.startswith(skip):
+                return f"{os.path.basename(fname)}:{frame.f_lineno}"
+            frame = frame.f_back
+        return "<unknown>"
+    finally:
+        del frame
+
+
+def _signature(op: str, rank: int, value: Any = None,
+               reduce_op: Optional[str] = None, src: Optional[int] = None,
+               dst: Optional[int] = None,
+               with_leaves: bool = True) -> Dict:
+    sig: Dict[str, Any] = {"op": op, "rank": rank, "site": _call_site()}
+    if reduce_op is not None:
+        sig["reduce"] = str(reduce_op).lower()
+    if src is not None:
+        sig["src"] = int(src)
+    if dst is not None:
+        sig["dst"] = int(dst)
+    if value is not None and with_leaves:
+        import jax
+        import numpy as np
+        leaves, treedef = jax.tree.flatten(value)
+        sig["tree"] = hashlib.sha256(
+            str(treedef).encode()).hexdigest()[:12]
+        sig["leaves"] = [[np.asarray(l).dtype.name,
+                          list(np.asarray(l).shape)] for l in leaves]
+    return sig
+
+
+def _first_divergence(ref: Dict, other: Dict) -> str:
+    for field in SEMANTIC_FIELDS:
+        if ref.get(field) != other.get(field):
+            return (f"{field}: {json.dumps(ref.get(field))} vs "
+                    f"{json.dumps(other.get(field))}")
+    return "<consistent>"
+
+
+def _ns() -> str:
+    import importlib
+    rdzv = importlib.import_module("tpu_dist.dist.rendezvous")
+    return f"tpu_dist/g{rdzv.generation()}/san"
+
+
+def check_collective(group, store, op: str, value: Any = None,
+                     reduce_op: Optional[str] = None,
+                     src: Optional[int] = None, dst: Optional[int] = None,
+                     with_leaves: bool = True) -> None:
+    """Publish this rank's signature for the next sanitized collective and
+    verify every peer announced an identical one; raises
+    :class:`CollectiveMismatchError` (never hangs: bounded by
+    ``TPU_DIST_SANITIZE_TIMEOUT``).
+
+    Called by the eager collectives (tpu_dist/collectives/eager.py) before
+    any payload moves; safe to call directly around custom store-based
+    synchronization as well."""
+    global _seq
+    n, me = group.num_processes, group.rank
+    if store is None or n <= 1:
+        return
+    seq, _seq = _seq, _seq + 1
+    mine = _signature(op, me, value=value, reduce_op=reduce_op, src=src,
+                      dst=dst, with_leaves=with_leaves)
+    base = f"{_ns()}/{seq}"
+    store.set(f"{base}/{me}", json.dumps(mine, sort_keys=True).encode())
+
+    timeout = _timeout()
+    deadline = time.monotonic() + timeout
+    peers = [r for r in range(n) if r != me]
+    waiting = set(peers)
+    delay = 0.0005
+    while waiting:
+        waiting = {r for r in waiting if not store.check(f"{base}/{r}")}
+        if not waiting:
+            break
+        if time.monotonic() > deadline:
+            missing = sorted(waiting)
+            raise CollectiveMismatchError(
+                me, seq, op, mine["site"],
+                f"collective sanitizer: rank {me} announced collective "
+                f"#{seq} ({op} at {mine['site']}) but rank(s) {missing} "
+                f"never announced theirs within {timeout:.0f}s "
+                f"(TPU_DIST_SANITIZE_TIMEOUT) — a rank-divergent "
+                f"collective: those ranks skipped this call or are blocked "
+                f"elsewhere", missing=missing)
+        time.sleep(delay)
+        delay = min(delay * 2, 0.02)
+
+    sigs = {me: mine}
+    for r in peers:
+        sigs[r] = json.loads(store.get(f"{base}/{r}"))
+        # ack-counter GC (the _store_all_gather_payload discipline): the
+        # last reader of a peer's signature deletes it
+        if store.add(f"{base}/{r}/ack", 1) >= n - 1:
+            store.delete_key(f"{base}/{r}")
+            store.delete_key(f"{base}/{r}/ack")
+
+    # reference = the majority signature (ties -> lowest rank holding one)
+    by_sem: Dict[str, List[int]] = {}
+    for r, sig in sigs.items():
+        key = json.dumps([sig.get(f) for f in SEMANTIC_FIELDS],
+                         sort_keys=True)
+        by_sem.setdefault(key, []).append(r)
+    if len(by_sem) == 1:
+        return
+    ref_ranks = max(by_sem.values(), key=lambda rs: (len(rs), -min(rs)))
+    ref = sigs[min(ref_ranks)]
+    divergent = {r: sigs[r] for rs in by_sem.values() if rs is not ref_ranks
+                 for r in rs}
+    detail = "; ".join(
+        f"rank {r} called {sigs[r].get('op')} at {sigs[r].get('site')} "
+        f"({_first_divergence(ref, sigs[r])})"
+        for r in sorted(divergent))
+    raise CollectiveMismatchError(
+        me, seq, op, mine["site"],
+        f"collective sanitizer: ranks diverged on collective #{seq}: "
+        f"majority ranks {sorted(ref_ranks)} called {ref.get('op')} at "
+        f"{ref.get('site')}, but {detail}", divergent=divergent)

@@ -103,6 +103,32 @@ def _default_group(group):
     return group
 
 
+# the armed values sanitizer.enabled() recognizes — the gate here must
+# parse identically or TPU_DIST_SANITIZE=0 would arm the check one-sidedly
+# (ranks disagreeing on armed-ness deadline-fail every healthy collective)
+_SANITIZE_ON = ("1", "true", "yes", "on")
+
+
+def _sanitize(op: str, group, store=None, **fields) -> None:
+    """Cross-rank signature check before a collective executes
+    (tpu_dist/analysis/sanitizer.py), active under ``TPU_DIST_SANITIZE=1``.
+
+    Off by default; the disabled path is one environment lookup — the
+    acceptance bound is ≤ 5% on the host-collective bench with the
+    sanitizer off.  Needs the control-plane store (signatures ride it even
+    when payloads take the mesh/data-plane), so store-less jobs skip the
+    check silently."""
+    if (os.environ.get("TPU_DIST_SANITIZE", "").strip().lower()
+            not in _SANITIZE_ON):
+        return
+    if store is None:
+        store = _coll_store()
+    if store is None or group.num_processes <= 1:
+        return
+    from ..analysis import sanitizer
+    sanitizer.check_collective(group, store, op, **fields)
+
+
 def all_reduce_host(x, group=None, op: str = ReduceOp.SUM):
     """Reduce a per-process host value across processes; returns the reduced
     value on host (as numpy / python scalar tree).
@@ -116,6 +142,7 @@ def all_reduce_host(x, group=None, op: str = ReduceOp.SUM):
     if group.num_processes <= 1:
         return jax.tree.map(np.asarray, x)
     store = _coll_store()
+    _sanitize("all_reduce", group, store, value=x, reduce_op=op)
     if store is None or _prefer_mesh(group):
         from jax.experimental import multihost_utils
         gathered = multihost_utils.process_allgather(x)  # leading axis = proc
@@ -160,6 +187,7 @@ def all_gather_host(x, group=None):
     if group.num_processes <= 1:
         return jax.tree.map(lambda v: np.asarray(v)[None], x)
     store = _coll_store()
+    _sanitize("all_gather", group, store, value=x)
     if store is None or _prefer_mesh(group):
         from jax.experimental import multihost_utils
         return multihost_utils.process_allgather(x)
@@ -202,6 +230,7 @@ def broadcast_host(x, group=None, src: int = 0):
     if group.num_processes <= 1:
         return jax.tree.map(np.asarray, x)
     store = _coll_store()
+    _sanitize("broadcast", group, store, value=x, src=src)
     if store is None or _prefer_mesh(group):
         from jax.experimental import multihost_utils
         return multihost_utils.broadcast_one_to_all(
@@ -260,7 +289,9 @@ def reduce_host(x, dst: int = 0, group=None, op: str = ReduceOp.SUM):
     _check_peer(dst, group, "dst")
     if group.num_processes <= 1:
         return jax.tree.map(np.asarray, x)
-    if _coll_store() is not None and not _prefer_mesh(group):
+    store = _coll_store()
+    _sanitize("reduce", group, store, value=x, reduce_op=op, dst=dst)
+    if store is not None and not _prefer_mesh(group):
         # rooted: ride the O(1)-per-rank store gather; only dst reduces
         gathered = gather_host(x, dst=dst, group=group)
         if gathered is None:
@@ -496,6 +527,8 @@ def gather_host(x, dst: int = 0, group=None) -> Optional[List]:
     if n <= 1:
         return [jax.tree.map(np.asarray, x)]
     store = _coll_store()
+    # no leaf signature: gather legitimately moves per-rank shapes
+    _sanitize("gather", group, store, dst=dst)
     if store is not None:
         seq = _next_seq("gather", dst)
         t0 = time.perf_counter()
@@ -564,6 +597,7 @@ def scatter_host(output_template, scatter_list: Optional[List] = None,
     # discipline; entries never fan out to bystanders).  Falls back to one
     # broadcast of the full list + local pick when no store is up.
     store = _coll_store()
+    _sanitize("scatter", group, store, value=output_template, src=src)
     if store is not None:
         seq = _next_seq("scatter", src)
         if group.rank == src:
@@ -711,6 +745,7 @@ def all_to_all_host(input_list: List[Any], group=None) -> List[Any]:
     if n <= 1:
         return list(input_list)
     store = _coll_store()
+    _sanitize("all_to_all", group, store)
     if store is not None:
         # pairwise store keys: rank p moves only its row (sends) and its
         # column (receives) — not every rank x rank entry like the

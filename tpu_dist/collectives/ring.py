@@ -140,6 +140,7 @@ def _recv_span(dp, src: int, tag: str, flat: np.ndarray, lo: int, hi: int,
     transport thread keeps reading the next one off the wire."""
     pos = lo
     while pos < hi:
+        # tpudlint: disable=TD004  # recv_array applies TPU_DIST_DP_TIMEOUT
         seg = dp.recv_array(src, tag)
         m = seg.size
         if pos + m > hi:

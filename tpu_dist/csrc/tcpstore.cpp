@@ -12,6 +12,9 @@
 //   response: u32 status(0=ok) | u32 data_len | data
 // Ops: 1=SET 2=GET(blocking) 3=ADD(i64 delta -> i64 new) 4=CHECK 5=DELETE
 //      6=NUMKEYS 7=WAIT_GE(i64 target; blocks until int(key) >= target)
+//      8=DELETE_PREFIX(erase every key starting with `key` -> i64 count;
+//        the restart-time reaper for a crashed generation's stale
+//        tpu_dist/g{gen}/... payload keys)
 //
 // Exposed via a C ABI (ctypes-friendly); the Python wrapper lives in
 // tpu_dist/dist/store.py and has a pure-Python implementation of the same
@@ -45,6 +48,7 @@ enum Op : uint8_t {
   OP_DELETE = 5,
   OP_NUMKEYS = 6,
   OP_WAIT_GE = 7,
+  OP_DELETE_PREFIX = 8,
 };
 
 bool send_all(int fd, const void* buf, size_t n) {
@@ -241,6 +245,24 @@ struct Server {
           reply(fd, stopping ? 1 : 0, "");
           break;
         }
+        case OP_DELETE_PREFIX: {
+          int64_t n = 0;
+          {
+            std::lock_guard<std::mutex> g(mu);
+            // std::map is ordered: every key with this prefix is a
+            // contiguous range starting at lower_bound(prefix)
+            auto it = kv.lower_bound(key);
+            while (it != kv.end() &&
+                   it->first.compare(0, key.size(), key) == 0) {
+              it = kv.erase(it);
+              ++n;
+            }
+          }
+          std::string out(sizeof(n), '\0');
+          std::memcpy(&out[0], &n, sizeof(n));
+          reply(fd, 0, out);
+          break;
+        }
         default:
           reply(fd, 2, "");
           break;
@@ -415,6 +437,20 @@ int tpudist_store_num_keys(void* h) {
   if (st == 0 && out && n >= 4) std::memcpy(&v, out, 4);
   free(out);
   return st == 0 ? static_cast<int>(v) : -1;
+}
+
+// Returns status (0 ok); the number of erased keys lands in *count.
+int tpudist_store_delete_prefix(void* h, const char* prefix,
+                                long long* count) {
+  uint8_t* out = nullptr;
+  uint32_t n = 0;
+  int st = static_cast<Client*>(h)->request(OP_DELETE_PREFIX, prefix, nullptr,
+                                            0, &out, &n);
+  long long v = 0;
+  if (st == 0 && out && n >= 8) std::memcpy(&v, out, 8);
+  free(out);
+  if (count) *count = v;
+  return st;
 }
 
 int tpudist_store_wait_ge(void* h, const char* key, long long target) {

@@ -28,8 +28,8 @@ from typing import List, Optional
 __all__ = ["Store", "TCPStore", "FileStore", "PyTCPStoreServer"]
 
 # Wire protocol op codes (must match csrc/tcpstore.cpp).
-_OP_SET, _OP_GET, _OP_ADD, _OP_CHECK, _OP_DELETE, _OP_NUMKEYS, _OP_WAIT_GE = \
-    range(1, 8)
+(_OP_SET, _OP_GET, _OP_ADD, _OP_CHECK, _OP_DELETE, _OP_NUMKEYS, _OP_WAIT_GE,
+ _OP_DELETE_PREFIX) = range(1, 9)
 
 
 class Store:
@@ -49,6 +49,15 @@ class Store:
         raise NotImplementedError
 
     def delete_key(self, key: str) -> bool:
+        raise NotImplementedError
+
+    def delete_prefix(self, prefix: str) -> int:
+        """Delete every key starting with ``prefix``; returns the count.
+
+        The restart-time reaper: a crashed generation's in-flight
+        ``tpu_dist/g{gen}/...`` payload keys are removed in one server-side
+        pass instead of leaking until the server dies
+        (tpu_dist/launch/cli.py `_reset_round_state`)."""
         raise NotImplementedError
 
     def num_keys(self) -> int:
@@ -189,6 +198,12 @@ class PyTCPStoreServer:
             with self._mu:
                 existed = self._kv.pop(key, None) is not None
             self._reply(conn, 0, b"1" if existed else b"0")
+        elif op == _OP_DELETE_PREFIX:
+            with self._mu:
+                doomed = [k for k in self._kv if k.startswith(key)]
+                for k in doomed:
+                    del self._kv[k]
+            self._reply(conn, 0, struct.pack("<q", len(doomed)))
         elif op == _OP_NUMKEYS:
             with self._mu:
                 n = len(self._kv)
@@ -222,7 +237,10 @@ FAULT_HOOK = None
 # connection; SET/ADD/DELETE are NOT — the server may have applied the op
 # before the connection died, and a blind resend would double-apply (fatal
 # for ADD-based barrier generations).  Those stay at-most-once.
-_IDEMPOTENT_OPS = frozenset({_OP_GET, _OP_CHECK, _OP_NUMKEYS, _OP_WAIT_GE})
+# DELETE_PREFIX replays safely (re-deleting an already-swept prefix removes
+# nothing more; only the returned count could differ) so it reconnects too.
+_IDEMPOTENT_OPS = frozenset({_OP_GET, _OP_CHECK, _OP_NUMKEYS, _OP_WAIT_GE,
+                             _OP_DELETE_PREFIX})
 _RECONNECT_ATTEMPTS = 4
 _RECONNECT_BACKOFF = 0.05  # doubles per attempt
 
@@ -372,6 +390,12 @@ class _NativeClient:
             if lib.tpudist_store_wait_ge(h, kb, target) != 0:
                 raise RuntimeError("store wait_ge failed")
             return b""
+        if op == _OP_DELETE_PREFIX:
+            result = ctypes.c_longlong()
+            if lib.tpudist_store_delete_prefix(h, kb,
+                                               ctypes.byref(result)) != 0:
+                raise ConnectionError("store delete_prefix failed")
+            return struct.pack("<q", result.value)
         raise ValueError(f"bad op {op}")
 
     def close(self):
@@ -412,6 +436,10 @@ def _bind_store(lib):
     lib.tpudist_store_wait_ge.restype = ctypes.c_int
     lib.tpudist_store_wait_ge.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong]
+    lib.tpudist_store_delete_prefix.restype = ctypes.c_int
+    lib.tpudist_store_delete_prefix.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_longlong)]
     lib.tpudist_store_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
     return lib
 
@@ -473,6 +501,10 @@ class TCPStore(Store):
 
     def delete_key(self, key: str) -> bool:
         return self._client.request(_OP_DELETE, key) == b"1"
+
+    def delete_prefix(self, prefix: str) -> int:
+        out = self._client.request(_OP_DELETE_PREFIX, prefix)
+        return struct.unpack("<q", out)[0]
 
     def num_keys(self) -> int:
         return struct.unpack(
@@ -570,6 +602,22 @@ class FileStore(Store):
             return True
         except FileNotFoundError:
             return False
+
+    def delete_prefix(self, prefix: str) -> int:
+        # the same "/"-flattening as _file: a key prefix maps to a filename
+        # prefix, so a directory listing finds every matching key
+        safe = prefix.replace("/", "_slash_")
+        n = 0
+        for f in os.listdir(self.path):
+            if f.startswith(".") or f.endswith(".tmp"):
+                continue
+            if f.startswith(safe):
+                try:
+                    os.unlink(os.path.join(self.path, f))
+                    n += 1
+                except FileNotFoundError:
+                    pass
+        return n
 
     def num_keys(self) -> int:
         return len([f for f in os.listdir(self.path)

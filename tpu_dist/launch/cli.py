@@ -105,6 +105,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "them as TPU_DIST_HEARTBEAT_TIMEOUT). 0 disables "
                         "the watchdog — a hung rank then waits on the "
                         "coordination-service timeout as before")
+    p.add_argument("--sanitize", action="store_true",
+                   help="enable the cross-rank collective sanitizer in "
+                        "every worker (TPU_DIST_SANITIZE=1): each eager "
+                        "host collective cross-checks op/shape/call-site "
+                        "agreement through the store before executing, so "
+                        "a rank-divergent collective raises a named "
+                        "CollectiveMismatchError within "
+                        "TPU_DIST_SANITIZE_TIMEOUT instead of hanging "
+                        "(tpu_dist/analysis/sanitizer.py)")
     p.add_argument("--standalone", action="store_true",
                    help="single-node mode with automatic rendezvous "
                         "(torchrun parity): forces --nnodes=1 "
@@ -222,6 +231,8 @@ def _spawn_world(args, world_size: int, master_port: int,
             if args.heartbeat_timeout > 0:
                 env["TPU_DIST_HEARTBEAT_TIMEOUT"] = str(
                     args.heartbeat_timeout)
+            if args.sanitize:
+                env["TPU_DIST_SANITIZE"] = "1"
             cmd = [sys.executable]
             if args.module:
                 cmd += ["-m", args.script]
@@ -237,6 +248,7 @@ def _spawn_world(args, world_size: int, master_port: int,
         for p in procs:
             if p.poll() is None:
                 p.kill()
+                # tpudlint: disable=TD004  # reaping a SIGKILLed child
                 p.wait()
         raise
     return procs
@@ -375,13 +387,14 @@ def _watch_world(args, procs: List[subprocess.Popen], store,
                 p.wait(timeout=max(0.1, deadline - time.monotonic()))
             except subprocess.TimeoutExpired:
                 p.kill()
+                # tpudlint: disable=TD004  # reaping a SIGKILLed child
                 p.wait()
         exit_code = 130
         interrupted = True
     return exit_code, interrupted
 
 
-def _reset_round_state(store, world_size: int,
+def _reset_round_state(store,
                        finished_round: Optional[int] = None) -> None:
     """Reset last round's control-plane state before a restart: liveness
     marks AND the teardown-barrier arrival counter — a partial teardown
@@ -390,16 +403,28 @@ def _reset_round_state(store, world_size: int,
     barrier early.  The finished round's heartbeat keys go too (they are
     generation-scoped, so this is pure GC — a stale publisher cannot
     refresh the next round's keys either way)."""
-    for r in range(world_size):
+    try:
+        # one server-side sweep instead of world_size delete_key
+        # round-trips (DELETE_PREFIX, wire op 8)
+        store.delete_prefix("tpu_dist/alive/")
+    except Exception:
+        pass
+    if finished_round is not None:
         try:
-            store.delete_key(f"tpu_dist/alive/{r}")
+            store.delete_prefix(f"tpu_dist/hb/{finished_round}/")
         except Exception:
             pass
-        if finished_round is not None:
-            try:
-                store.delete_key(f"tpu_dist/hb/{finished_round}/{r}")
-            except Exception:
-                pass
+        # reap the crashed generation's ENTIRE keyspace (in-flight
+        # collective payloads, dp addresses, p2p frames, sanitizer
+        # signatures): one server-side DELETE_PREFIX sweep.  Safe because
+        # every worker of generation N scopes its payload keys under
+        # tpu_dist/g{N}/ and the gang is already torn down when this runs;
+        # without it each failed round leaked up to one step's payloads
+        # (the PR 2 KNOWN LIMIT this closes).
+        try:
+            store.delete_prefix(f"tpu_dist/g{finished_round}/")
+        except Exception:
+            pass
     try:
         store.delete_key("__barrier__/teardown")
     except Exception:
@@ -495,8 +520,7 @@ def _elastic_agree(args, store, rnd: int, local_rc: int,
         if args.node_rank == 0:
             if negotiated_port:
                 rc_port = _free_port()
-            _reset_round_state(store, args.nproc_per_node * nnodes,
-                               finished_round=rnd)
+            _reset_round_state(store, finished_round=rnd)
             store.set(f"{prefix}/go/{rnd}", str(rc_port).encode())
         else:
             store.wait([f"{prefix}/go/{rnd}"],
@@ -590,8 +614,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 f"restart {restarts}/{args.max_restarts} — relaunching "
                 f"the world\n")
             if store is not None:
-                _reset_round_state(store, world_size,
-                                   finished_round=restarts - 1)
+                _reset_round_state(store, finished_round=restarts - 1)
             _restart_backoff(args, restarts)
             if negotiated_port:
                 # the old coordinator socket may still be in TIME_WAIT;
