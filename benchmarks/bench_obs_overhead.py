@@ -1,0 +1,165 @@
+"""Benchmark: armed flight-recorder overhead on the host-collective bench.
+
+Runs the ISSUE 2 host-collective benchmark (``bench_host_collectives``,
+world-2 workers wired exactly as production sees the eager collectives)
+twice — recorder disarmed vs armed (``TPU_DIST_OBS=1``) — and reports the
+throughput delta per (op, transport).  The headline number is the MEDIAN
+overhead across cases: robust to one noisy configuration on a shared box.
+
+``--smoke`` (the tier-1 configuration, wired through tests/test_obs.py):
+world 2, 1 MiB payloads, and the ISSUE 4 acceptance gate — median armed
+overhead must stay **under 5%**.  Socket benchmarks on a shared 2-core box
+are scheduler-noisy (single-shot case variance far exceeds the bound in
+BOTH directions), so each attempt folds into a per-case best-of-N (max
+MB/s per arm — the standard low-noise throughput estimator; noise only
+ever *lowers* a measurement) with the arm order alternated per attempt,
+and the gate passes as soon as the best-vs-best median meets the bound.
+
+Prints one BENCH-style JSON line per attempt::
+
+    {"metric": "obs_overhead_pct", "value": 1.7, "unit": "%",
+     "threshold": 5.0, "attempt": 0, "per_case": {...}}
+
+Exit code: 0 (bound met / non-smoke run), 1 (smoke bound exceeded on every
+attempt).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import statistics
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SMOKE_SIZES = [1 << 20]
+
+
+def _measure(armed: bool, worlds, sizes, iters: int, ops=None):
+    """One bench_host_collectives pass; returns {(op, path, world, bytes):
+    MB/s}.  The armed flag is exported through the environment the worker
+    subprocesses inherit.  ``ops`` restricts the measured collectives (the
+    smoke drops rooted broadcast: its receiver sits in the store's 10 ms
+    wait-poll, so its wall time is quantized — amplifying scheduler noise
+    that has nothing to do with the recorder)."""
+    from benchmarks import bench_host_collectives as B
+
+    saved = {k: os.environ.pop(k, None)
+             for k in ("TPU_DIST_OBS", "TPU_DIST_OBS_DIR")}
+    saved_ops = B._OPS
+    if ops:
+        B._OPS = tuple(ops)
+    obs_dir = None
+    if armed:
+        obs_dir = tempfile.mkdtemp(prefix="tpu_dist_obs_bench_")
+        os.environ["TPU_DIST_OBS"] = "1"
+        os.environ["TPU_DIST_OBS_DIR"] = obs_dir
+    try:
+        rows = []
+        for world in worlds:
+            fd, out_path = tempfile.mkstemp(suffix=".json")
+            os.close(fd)
+            try:
+                rows.extend(B._run_world(world, list(sizes), iters,
+                                         check=False, out_path=out_path))
+            finally:
+                try:
+                    os.unlink(out_path)
+                except OSError:
+                    pass
+        return {(r["op"], r["path"], r["world"], r["bytes"]): r["value"]
+                for r in rows}
+    finally:
+        B._OPS = saved_ops
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        if obs_dir is not None:
+            shutil.rmtree(obs_dir, ignore_errors=True)  # worker dumps
+
+
+def _merge_best(best: dict, fresh: dict) -> None:
+    for key, value in fresh.items():
+        if value and value > best.get(key, 0.0):
+            best[key] = value
+
+
+def _overhead(best_base: dict, best_armed: dict):
+    per_case = {}
+    overheads = []
+    for key, disarmed_v in sorted(best_base.items()):
+        armed_v = best_armed.get(key)
+        if not armed_v or not disarmed_v:
+            continue
+        pct = (disarmed_v - armed_v) / disarmed_v * 100.0
+        per_case["/".join(str(p) for p in key)] = round(pct, 2)
+        overheads.append(pct)
+    return (statistics.median(overheads) if overheads else 0.0), per_case
+
+
+def _one_attempt(attempt: int, worlds, sizes, iters: int, smoke: bool,
+                 best_base: dict, best_armed: dict, ops=None) -> float:
+    # alternate arm order across attempts: whatever warmth/contention the
+    # first run pays must not systematically land on one arm
+    arms = (False, True) if attempt % 2 == 0 else (True, False)
+    for armed in arms:
+        _merge_best(best_armed if armed else best_base,
+                    _measure(armed, worlds, sizes, iters, ops=ops))
+    med, per_case = _overhead(best_base, best_armed)
+    print(json.dumps({"metric": "obs_overhead_pct", "value": round(med, 2),
+                      "unit": "%", "threshold": 5.0, "attempt": attempt,
+                      "smoke": smoke, "per_case": per_case}))
+    sys.stdout.flush()
+    return med
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="world=2, 1 MiB, assert median overhead < 5% "
+                         "(the tier-1 configuration)")
+    ap.add_argument("--worlds", type=int, nargs="*", default=None)
+    ap.add_argument("--sizes", type=int, nargs="*", default=None)
+    ap.add_argument("--iters", type=int, default=0,
+                    help="per-case iterations (0 = 40 for smoke, bench "
+                         "auto otherwise)")
+    ap.add_argument("--attempts", type=int, default=4,
+                    help="smoke retries before declaring the bound missed")
+    args = ap.parse_args(argv)
+
+    worlds = args.worlds or [2]
+    sizes = args.sizes or (_SMOKE_SIZES if args.smoke
+                           else [64 << 10, 1 << 20])
+    # smoke iters are deliberately high: a 1 MiB collective takes single-
+    # digit ms, so a short measurement is one scheduler hiccup away from a
+    # ±50% swing — 40 iterations push each case to hundreds of ms while
+    # worker startup (jax import) still dominates the wall time
+    iters = args.iters or (40 if args.smoke else 0)
+    ops = ("all_reduce", "all_gather") if args.smoke else None
+
+    attempts = args.attempts if args.smoke else 1
+    best_base: dict = {}
+    best_armed: dict = {}
+    med = None
+    for attempt in range(attempts):
+        med = _one_attempt(attempt, worlds, sizes, iters, args.smoke,
+                           best_base, best_armed, ops=ops)
+        if not args.smoke or med < 5.0:
+            break
+    if args.smoke and (med is None or med >= 5.0):
+        print(json.dumps({"metric": "obs_overhead_pct", "verdict": "FAIL",
+                          "value": round(med, 2) if med is not None
+                          else None, "threshold": 5.0}))
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, _REPO)
+    sys.exit(main())

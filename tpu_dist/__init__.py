@@ -19,6 +19,8 @@ TPU.  Currently shipped subpackages:
 - ``tpu_dist.resilience`` — heartbeat watchdog, auto-resume, chaos faults
 - ``tpu_dist.analysis`` — tpudlint static checker + runtime collective
   sanitizer (distributed-correctness tooling)
+- ``tpu_dist.obs`` — collective flight recorder, cross-rank trace
+  timeline, hang diagnosis (``python -m tpu_dist.obs``)
 - ``tpu_dist.utils`` — rank-0 logging, metric windows, profiling
 - ``tpu_dist.ops`` — Pallas TPU kernels (fused CE, flash attention)
 """
@@ -26,8 +28,8 @@ TPU.  Currently shipped subpackages:
 __version__ = "0.1.0"
 
 from . import (analysis, checkpoint, collectives, data, dist, interop,
-               models, nn, optim, parallel, resilience, utils)
+               models, nn, obs, optim, parallel, resilience, utils)
 
 __all__ = ["nn", "optim", "models", "dist", "collectives", "data",
            "parallel", "checkpoint", "resilience", "utils", "interop",
-           "analysis", "__version__"]
+           "analysis", "obs", "__version__"]

@@ -91,20 +91,10 @@ def reset() -> None:
 
 
 def _call_site() -> str:
-    """First stack frame outside the collectives/analysis machinery."""
-    import inspect
-    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    skip = (os.path.join(here, "collectives"), os.path.join(here, "analysis"))
-    frame = inspect.currentframe()
-    try:
-        while frame is not None:
-            fname = frame.f_code.co_filename
-            if not fname.startswith(skip):
-                return f"{os.path.basename(fname)}:{frame.f_lineno}"
-            frame = frame.f_back
-        return "<unknown>"
-    finally:
-        del frame
+    """First stack frame outside the collectives/analysis machinery
+    (delegates to the shared attribution helper in tpu_dist.obs)."""
+    from ..obs.recorder import call_site
+    return call_site(skip_parts=("collectives", "analysis"))
 
 
 def _signature(op: str, rank: int, value: Any = None,

@@ -38,6 +38,12 @@ All coll/p2p store keys are namespaced by the gang *generation*
 (``TPU_DIST_RESTART_COUNT``): a restarted incarnation starts its sequence
 counters at 0 in a fresh keyspace, so stale keys from a failed generation
 can never be matched by the new one.
+
+With the flight recorder armed (``TPU_DIST_OBS=1``, tpu_dist/obs) every
+collective here opens a span event — lockstep sequence number, payload
+digest, transport path, call-site, outcome — before any payload moves, so
+a hung collective is visible in the crash dump and the cross-rank merge
+can name the straggler.
 """
 
 from __future__ import annotations
@@ -141,13 +147,15 @@ def all_reduce_host(x, group=None, op: str = ReduceOp.SUM):
     fn = _reduce_fn(op)  # validate op before the fast path returns
     if group.num_processes <= 1:
         return jax.tree.map(np.asarray, x)
-    store = _coll_store()
-    _sanitize("all_reduce", group, store, value=x, reduce_op=op)
-    if store is None or _prefer_mesh(group):
-        from jax.experimental import multihost_utils
-        gathered = multihost_utils.process_allgather(x)  # leading axis = proc
-        return jax.tree.map(fn, gathered)
-    return _routed_all_reduce(x, group, store, op, fn)
+    with _obs_span("all_reduce", value=x, reduce_op=op):
+        store = _coll_store()
+        _sanitize("all_reduce", group, store, value=x, reduce_op=op)
+        if store is None or _prefer_mesh(group):
+            _obs_mesh()
+            from jax.experimental import multihost_utils
+            gathered = multihost_utils.process_allgather(x)  # lead axis=proc
+            return jax.tree.map(fn, gathered)
+        return _routed_all_reduce(x, group, store, op, fn)
 
 
 def _routed_all_reduce(x, group, store, op, fn):
@@ -186,12 +194,14 @@ def all_gather_host(x, group=None):
     group = _default_group(group)
     if group.num_processes <= 1:
         return jax.tree.map(lambda v: np.asarray(v)[None], x)
-    store = _coll_store()
-    _sanitize("all_gather", group, store, value=x)
-    if store is None or _prefer_mesh(group):
-        from jax.experimental import multihost_utils
-        return multihost_utils.process_allgather(x)
-    return _routed_all_gather(x, group, store)
+    with _obs_span("all_gather", value=x):
+        store = _coll_store()
+        _sanitize("all_gather", group, store, value=x)
+        if store is None or _prefer_mesh(group):
+            _obs_mesh()
+            from jax.experimental import multihost_utils
+            return multihost_utils.process_allgather(x)
+        return _routed_all_gather(x, group, store)
 
 
 def _routed_all_gather(x, group, store):
@@ -229,14 +239,16 @@ def broadcast_host(x, group=None, src: int = 0):
     group = _default_group(group)
     if group.num_processes <= 1:
         return jax.tree.map(np.asarray, x)
-    store = _coll_store()
-    _sanitize("broadcast", group, store, value=x, src=src)
-    if store is None or _prefer_mesh(group):
-        from jax.experimental import multihost_utils
-        return multihost_utils.broadcast_one_to_all(
-            x, is_source=group.rank == src)
-    _check_peer(src, group, "src")
-    return _routed_broadcast(x, group, store, src)
+    with _obs_span("broadcast", value=x, src=src):
+        store = _coll_store()
+        _sanitize("broadcast", group, store, value=x, src=src)
+        if store is None or _prefer_mesh(group):
+            _obs_mesh()
+            from jax.experimental import multihost_utils
+            return multihost_utils.broadcast_one_to_all(
+                x, is_source=group.rank == src)
+        _check_peer(src, group, "src")
+        return _routed_broadcast(x, group, store, src)
 
 
 def _routed_broadcast(x, group, store, src):
@@ -289,19 +301,21 @@ def reduce_host(x, dst: int = 0, group=None, op: str = ReduceOp.SUM):
     _check_peer(dst, group, "dst")
     if group.num_processes <= 1:
         return jax.tree.map(np.asarray, x)
-    store = _coll_store()
-    _sanitize("reduce", group, store, value=x, reduce_op=op, dst=dst)
-    if store is not None and not _prefer_mesh(group):
-        # rooted: ride the O(1)-per-rank store gather; only dst reduces
-        gathered = gather_host(x, dst=dst, group=group)
-        if gathered is None:
+    with _obs_span("reduce", value=x, reduce_op=op, dst=dst):
+        store = _coll_store()
+        _sanitize("reduce", group, store, value=x, reduce_op=op, dst=dst)
+        if store is not None and not _prefer_mesh(group):
+            # rooted: ride the O(1)-per-rank store gather; only dst reduces
+            gathered = gather_host(x, dst=dst, group=group)
+            if gathered is None:
+                return None
+            return jax.tree.map(lambda *vs: fn(np.stack(vs)), *gathered)
+        _obs_mesh()
+        from jax.experimental import multihost_utils
+        gathered = multihost_utils.process_allgather(x)
+        if group.rank != dst:
             return None
-        return jax.tree.map(lambda *vs: fn(np.stack(vs)), *gathered)
-    from jax.experimental import multihost_utils
-    gathered = multihost_utils.process_allgather(x)
-    if group.rank != dst:
-        return None
-    return jax.tree.map(fn, gathered)
+        return jax.tree.map(fn, gathered)
 
 
 # -- O(1)-per-rank store transport for rooted collectives ---------------------
@@ -467,8 +481,26 @@ def _partition_and_dp(arrs, group, store, reduce_op=None):
 
 
 def _record(op: str, path: str, nbytes: int, t0: float) -> None:
-    from ..utils import metrics
-    metrics.record_collective(op, path, nbytes, time.perf_counter() - t0)
+    # single ingestion point: feeds the per-(op, transport) counters AND
+    # stamps the enclosing flight-recorder span with the path taken
+    from ..obs import recorder as _obs
+    _obs.record_transport(op, path, nbytes, time.perf_counter() - t0)
+
+
+def _obs_span(op: str, value=None, reduce_op=None, src=None, dst=None,
+              peer=None, kind: str = "collective"):
+    """Flight-recorder span around one eager collective (tpu_dist.obs);
+    disarmed -> a shared no-op context, one env lookup."""
+    from ..obs import hooks as _hooks
+    return _hooks.collective_span(op, value=value, reduce_op=reduce_op,
+                                  src=src, dst=dst, peer=peer, kind=kind)
+
+
+def _obs_mesh() -> None:
+    """Mark the enclosing span as having ridden the XLA mesh collectives
+    (the one transport record_transport never sees)."""
+    from ..obs import hooks as _hooks
+    _hooks.note_path("mesh")
 
 
 def _next_seq(op: str, root: int) -> int:
@@ -526,6 +558,11 @@ def gather_host(x, dst: int = 0, group=None) -> Optional[List]:
     n = group.num_processes
     if n <= 1:
         return [jax.tree.map(np.asarray, x)]
+    with _obs_span("gather", value=x, dst=dst):
+        return _gather_host(x, dst, group, n)
+
+
+def _gather_host(x, dst, group, n):
     store = _coll_store()
     # no leaf signature: gather legitimately moves per-rank shapes
     _sanitize("gather", group, store, dst=dst)
@@ -557,6 +594,7 @@ def gather_host(x, dst: int = 0, group=None) -> Optional[List]:
                 store.delete_key(key)
         _record("gather", "store", nbytes, t0)
         return out
+    _obs_mesh()
     from jax.experimental import multihost_utils
     gathered = multihost_utils.process_allgather(x)
     if group.rank != dst:
@@ -592,6 +630,13 @@ def scatter_host(output_template, scatter_list: Optional[List] = None,
                     f"semantics)")
         if n <= 1:
             return payload[0]
+    else:
+        payload = None
+    with _obs_span("scatter", value=output_template, src=src):
+        return _scatter_host(output_template, payload, src, group, n)
+
+
+def _scatter_host(output_template, payload, src, group, n):
     # O(1)-per-rank path: src posts one store key per destination, each
     # rank fetches only its own entry (send/recv's matched-by-program-order
     # discipline; entries never fan out to bystanders).  Falls back to one
@@ -600,19 +645,25 @@ def scatter_host(output_template, scatter_list: Optional[List] = None,
     _sanitize("scatter", group, store, value=output_template, src=src)
     if store is not None:
         seq = _next_seq("scatter", src)
+        t0 = time.perf_counter()
         if group.rank == src:
+            nbytes = 0
             for dst in range(n):
                 if dst != src:
-                    store.set(_coll_key("scatter", src, seq, dst),
-                              _tree_to_bytes(payload[dst]))
+                    raw = _tree_to_bytes(payload[dst])
+                    nbytes += len(raw)
+                    store.set(_coll_key("scatter", src, seq, dst), raw)
+            _record("scatter", "store", nbytes, t0)
             return payload[src]
         key = _coll_key("scatter", src, seq, group.rank)
         raw = store.get(key)       # blocks until src posts it
         store.delete_key(key)
+        _record("scatter", "store", len(raw), t0)
         return _tree_from_bytes(raw)
     if group.rank != src:
         payload = [jax.tree.map(lambda v: np.zeros_like(np.asarray(v)),
                                 output_template) for _ in range(n)]
+    _obs_mesh()
     from jax.experimental import multihost_utils
     full = multihost_utils.broadcast_one_to_all(
         payload, is_source=group.rank == src)
@@ -744,6 +795,11 @@ def all_to_all_host(input_list: List[Any], group=None) -> List[Any]:
                          f"(num_processes={n}), got {len(input_list)}")
     if n <= 1:
         return list(input_list)
+    with _obs_span("all_to_all", value=input_list):
+        return _all_to_all_host(input_list, group, n)
+
+
+def _all_to_all_host(input_list, group, n):
     store = _coll_store()
     _sanitize("all_to_all", group, store)
     if store is not None:
@@ -752,6 +808,8 @@ def all_to_all_host(input_list: List[Any], group=None) -> List[Any]:
         # all-gather fallback
         me = group.rank
         seq = _next_seq("a2a", 0)
+        t0 = time.perf_counter()
+        nbytes = 0
         for q in range(n):
             if q != me:
                 # plain pickle (object transport): entries may be arrays
@@ -764,8 +822,14 @@ def all_to_all_host(input_list: List[Any], group=None) -> List[Any]:
                 out.append(input_list[me])
             else:
                 key = _coll_key("a2a", me, seq, r)
-                out.append(pickle.loads(store.get(key)))
+                raw = store.get(key)
+                # count ONE direction (the fetched column), matching the
+                # per-rank convention of gather/scatter — counting sends
+                # too would double every byte relative to the other ops
+                nbytes += len(raw)
+                out.append(pickle.loads(raw))
                 store.delete_key(key)
+        _record("all_to_all", "store", nbytes, t0)
         return out
     rows = all_gather_object(list(input_list), group)
     return [rows[r][group.rank] for r in range(n)]
@@ -822,23 +886,24 @@ def send(x, dst: int, group=None, tag: int = 0) -> None:
     # so a caller that recovers and retries stays matched with the receiver
     seq = _p2p_send_seq.get((me, dst, tag), 0)
     arr = np.asarray(x)
-    t0 = time.perf_counter()
-    # same backend-aware gate as the collectives: on real accelerator
-    # backends (auto mode) p2p keeps riding the always-reachable store —
-    # a pod whose fabric only admits coordinator/store traffic must not
-    # suddenly need rank-to-rank TCP for a send that used to work
-    if _dp_leaf_ok(arr) and not _prefer_mesh(group):
-        dp = _maybe_data_plane(group, store)
-        if dp is not None:
-            dp.send_array(dst, _p2p_wire_tag(tag, seq), arr)
-            _p2p_send_seq[(me, dst, tag)] = seq + 1
-            _record("send", "dataplane", arr.nbytes, t0)
-            return
-    buf = io.BytesIO()
-    np.save(buf, arr, allow_pickle=False)
-    store.set(_p2p_key(me, dst, tag, seq), buf.getvalue())
-    _p2p_send_seq[(me, dst, tag)] = seq + 1
-    _record("send", "store", arr.nbytes, t0)
+    with _obs_span("send", value=arr, dst=dst, kind="p2p"):
+        t0 = time.perf_counter()
+        # same backend-aware gate as the collectives: on real accelerator
+        # backends (auto mode) p2p keeps riding the always-reachable store —
+        # a pod whose fabric only admits coordinator/store traffic must not
+        # suddenly need rank-to-rank TCP for a send that used to work
+        if _dp_leaf_ok(arr) and not _prefer_mesh(group):
+            dp = _maybe_data_plane(group, store)
+            if dp is not None:
+                dp.send_array(dst, _p2p_wire_tag(tag, seq), arr)
+                _p2p_send_seq[(me, dst, tag)] = seq + 1
+                _record("send", "dataplane", arr.nbytes, t0)
+                return
+        buf = io.BytesIO()
+        np.save(buf, arr, allow_pickle=False)
+        store.set(_p2p_key(me, dst, tag, seq), buf.getvalue())
+        _p2p_send_seq[(me, dst, tag)] = seq + 1
+        _record("send", "store", arr.nbytes, t0)
 
 
 # mesh (weak) -> {(axis, src, dst): jitted mover}; weak so compiled movers
@@ -905,6 +970,12 @@ def recv(src: int, group=None, tag: int = 0) -> np.ndarray:
     if not 0 <= src < group.num_processes:
         raise ValueError(f"src {src} out of range "
                          f"(num_processes={group.num_processes})")
+    with _obs_span("recv", src=src, kind="p2p"):
+        return _recv(src, group, tag)
+
+
+def _recv(src: int, group, tag: int) -> np.ndarray:
+    me = group.rank
     store = _p2p_store()
     # seq consumed only on delivery (mirrors send): a recv that raises
     # (timeout, dead peer) leaves the counter untouched, so a retry waits
@@ -928,7 +999,7 @@ def recv(src: int, group=None, tag: int = 0) -> np.ndarray:
           if _dp_enabled() and not _prefer_mesh(group) else None)
     if dp is None:
         return _from_store()  # blocking get until the key exists
-    from .transport import PeerGoneError, _default_timeout
+    from .transport import _default_timeout
     wire_tag = _p2p_wire_tag(tag, seq)
     delay = 0.0002
     timeout = _default_timeout()
@@ -949,7 +1020,7 @@ def recv(src: int, group=None, tag: int = 0) -> np.ndarray:
                 return _delivered(arr, "dataplane")
             if store.check(key):
                 continue
-            raise PeerGoneError(src, gone)
+            raise dp.gone_error(src, gone)
         if deadline is not None and time.monotonic() > deadline:
             # a sender that died before ever connecting leaves no inbound
             # socket to diagnose — the deadline converts that into a named

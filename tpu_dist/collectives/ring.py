@@ -155,6 +155,15 @@ def _recv_span(dp, src: int, tag: str, flat: np.ndarray, lo: int, hi: int,
         pos += m
 
 
+def _obs_span(op: str, value):
+    """Flight-recorder span for one host ring collective (tpu_dist.obs):
+    ring phases are where a dead/slow peer actually manifests, so they get
+    their own lockstep-sequenced span nested under the eager caller's (or
+    standalone, for direct DataPlane users)."""
+    from ..obs import hooks as _hooks
+    return _hooks.collective_span(op, value=value, path="dataplane")
+
+
 def _prepare(dp, x, op: str):
     x = np.asarray(x)
     op = str(op).lower()
@@ -213,15 +222,17 @@ def ring_all_reduce(dp, x, op: str = "sum", tag: str = "ar",
         return flat.astype(out_dtype).reshape(x.shape)
     bounds = _bounds(flat.size, n)
     utag = f"{tag}/rar"
-    _reduce_scatter_phase(dp, flat, bounds, n, r, op, utag, wire)
-    lo, hi = bounds[r]
-    if op in ("avg", "mean"):
-        flat[lo:hi] = flat[lo:hi] / n
-    if wire is not None:
-        # re-quantize the owned chunk through the wire dtype so the values
-        # this rank keeps match the compressed copies every peer receives
-        flat[lo:hi] = flat[lo:hi].astype(wire).astype(flat.dtype)
-    _all_gather_phase(dp, flat, bounds, n, r, utag, wire)
+    with _obs_span("ring_all_reduce", x):
+        _reduce_scatter_phase(dp, flat, bounds, n, r, op, utag, wire)
+        lo, hi = bounds[r]
+        if op in ("avg", "mean"):
+            flat[lo:hi] = flat[lo:hi] / n
+        if wire is not None:
+            # re-quantize the owned chunk through the wire dtype so the
+            # values this rank keeps match the compressed copies every peer
+            # receives
+            flat[lo:hi] = flat[lo:hi].astype(wire).astype(flat.dtype)
+        _all_gather_phase(dp, flat, bounds, n, r, utag, wire)
     return flat.astype(out_dtype, copy=False).reshape(x.shape)
 
 
@@ -237,7 +248,9 @@ def ring_reduce_scatter(dp, x, op: str = "sum", tag: str = "rs",
     wire = np.dtype(comm_dtype) if comm_dtype is not None else None
     bounds = _bounds(flat.size, n)
     if flat.size:
-        _reduce_scatter_phase(dp, flat, bounds, n, r, op, f"{tag}/rrs", wire)
+        with _obs_span("ring_reduce_scatter", x):
+            _reduce_scatter_phase(dp, flat, bounds, n, r, op, f"{tag}/rrs",
+                                  wire)
     lo, hi = bounds[r]
     chunk = flat[lo:hi]
     if op in ("avg", "mean"):
@@ -258,11 +271,13 @@ def ring_all_gather(dp, x, tag: str = "ag") -> np.ndarray:
     out[r] = flat
     right, left = (r + 1) % n, (r - 1) % n
     utag = f"{tag}/rag"
-    for step in range(n - 1):
-        si = (r - step) % n
-        ri = (r - step - 1) % n
-        _send_span(dp, right, utag, out[si], 0, flat.size, wire_dtype=None)
-        _recv_span(dp, left, utag, out[ri], 0, flat.size, combine=None)
+    with _obs_span("ring_all_gather", x):
+        for step in range(n - 1):
+            si = (r - step) % n
+            ri = (r - step - 1) % n
+            _send_span(dp, right, utag, out[si], 0, flat.size,
+                       wire_dtype=None)
+            _recv_span(dp, left, utag, out[ri], 0, flat.size, combine=None)
     return out.reshape((n,) + x.shape)
 
 
@@ -284,14 +299,15 @@ def tree_broadcast(dp, x, src: int = 0, tag: str = "bc") -> np.ndarray:
         flat = np.empty(x.size, dtype=x.dtype)
     utag = f"{tag}/tbc"
     k = 1
-    while k < n:
-        if rel < k:
-            peer_rel = rel + k
-            if peer_rel < n:
-                _send_span(dp, (src + peer_rel) % n, utag, flat, 0,
-                           flat.size, wire_dtype=None)
-        elif rel < 2 * k:
-            _recv_span(dp, (src + rel - k) % n, utag, flat, 0, flat.size,
-                       combine=None)
-        k *= 2
+    with _obs_span("tree_broadcast", x):
+        while k < n:
+            if rel < k:
+                peer_rel = rel + k
+                if peer_rel < n:
+                    _send_span(dp, (src + peer_rel) % n, utag, flat, 0,
+                               flat.size, wire_dtype=None)
+            elif rel < 2 * k:
+                _recv_span(dp, (src + rel - k) % n, utag, flat, 0,
+                           flat.size, combine=None)
+            k *= 2
     return flat.reshape(x.shape)

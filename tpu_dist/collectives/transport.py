@@ -67,6 +67,7 @@ class PeerGoneError(ConnectionError):
 
     def __init__(self, peer: int, detail: str = ""):
         self.peer = int(peer)
+        self.detail = detail
         msg = f"data-plane peer rank {peer} is gone"
         if detail:
             msg += f": {detail}"
@@ -219,6 +220,7 @@ class DataPlane:
                 # wait for its frames again instead of failing spuriously
                 self._dead.pop(peer, None)
                 self._in_conn[peer] = conn
+            self._obs("peer-connect", peer)
             while True:
                 frame = self._read_frame(conn)
                 if frame is None:
@@ -235,6 +237,7 @@ class DataPlane:
             except OSError:
                 pass
             if peer is not None and not self._closing:
+                died = False
                 with self._cv:
                     # only this peer's CURRENT connection may declare it
                     # dead: a stale reader observing its own superseded
@@ -243,6 +246,10 @@ class DataPlane:
                         del self._in_conn[peer]
                         self._dead[peer] = detail
                         self._cv.notify_all()
+                        died = True
+                if died:
+                    self._obs("peer-gone", peer, detail=detail,
+                              outcome="error:PeerGone")
 
     def _read_frame(self, conn):
         raw = _recv_exact(conn, _U32.size)
@@ -313,6 +320,7 @@ class DataPlane:
             payload = arr.tobytes()  # exotic dtypes without buffer support
         header = _encode_frame_header(
             tag.encode(), arr.dtype.name.encode(), shape, len(payload))
+        send_err = None
         with self._out_lock(dst):
             sock = self._out.get(dst)
             try:
@@ -322,8 +330,9 @@ class DataPlane:
                 sock.sendall(header)
                 if len(payload):
                     sock.sendall(payload)
-            except PeerGoneError:
-                raise  # _connect already diagnosed the peer
+            except PeerGoneError as e:
+                send_err = e  # _connect diagnosed the peer; the obs-tail
+                # enrichment still happens below, outside the lock
             except OSError as e:
                 self._out.pop(dst, None)
                 try:
@@ -331,10 +340,44 @@ class DataPlane:
                         sock.close()
                 except OSError:
                     pass
-                raise PeerGoneError(dst, repr(e)) from e
+                send_err = e  # diagnose outside the lock: gone_error's
+                # obs-tail lookup is a store round-trip, and senders to
+                # this dst must not queue behind a diagnostic
+        if send_err is not None:
+            detail = (send_err.detail if isinstance(send_err, PeerGoneError)
+                      else repr(send_err))
+            raise self.gone_error(dst, detail) from send_err
         return len(payload)
 
     # -- receive -------------------------------------------------------------
+
+    def _obs(self, op: str, peer: int, **fields) -> None:
+        """Record a transport lifecycle event on the flight recorder
+        (no-op when disarmed; must never raise into the reader threads)."""
+        try:
+            from ..obs.recorder import safe_record
+        except Exception:
+            return
+        safe_record("transport", op, peer=peer, **fields)
+
+    def gone_error(self, peer: int, detail: str = "") -> PeerGoneError:
+        """A :class:`PeerGoneError` for ``peer``, enriched (when the flight
+        recorder is armed) with the peer's last posted position from the
+        store — the dead rank cannot speak for itself, but its obs tail
+        can.  Call OUTSIDE any transport lock: the lookup is a store
+        round-trip."""
+        try:
+            from ..obs import hooks as _obs_hooks
+            from ..obs import recorder as _obs_rec
+            if _obs_rec.enabled():
+                tail = _obs_hooks.fetch_tail(self._store, self.generation,
+                                             peer)
+                if tail is not None:
+                    extra = f"peer's last obs: {_obs_hooks.render_tail(tail)}"
+                    detail = f"{detail}; {extra}" if detail else extra
+        except Exception:
+            pass
+        return PeerGoneError(peer, detail)
 
     def try_recv_array(self, src: int, tag: str):
         """Non-blocking: the next queued frame from ``(src, tag)`` or None."""
@@ -373,7 +416,9 @@ class DataPlane:
                 if arr is not None:
                     return arr
                 if src in self._dead:
-                    raise PeerGoneError(src, self._dead[src])
+                    dead_detail = self._dead[src]
+                    break  # raise outside the lock: the obs-tail lookup
+                    # in gone_error is a store round-trip
                 if self._closing:
                     raise RuntimeError("data plane closed during recv")
                 if deadline is None:
@@ -385,6 +430,7 @@ class DataPlane:
                             f"data-plane recv from rank {src} tag {tag!r} "
                             f"timed out after {timeout:.0f}s")
                     self._cv.wait(min(left, 1.0))
+        raise self.gone_error(src, dead_detail)
 
     # -- lifecycle -----------------------------------------------------------
 

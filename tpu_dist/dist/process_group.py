@@ -432,6 +432,13 @@ def abort(exit_code: int = 1, reason: str = "") -> None:
     if reason:
         print(f"tpu_dist.abort: {reason}", file=_sys.stderr)
     try:
+        # os._exit skips atexit, so the flight recorder (if armed) must
+        # flush here — the abort path IS the interesting crash dump
+        from ..obs import recorder as _obs_recorder
+        _obs_recorder.dump_now(f"abort:{exit_code}")
+    except Exception:
+        pass
+    try:
         _sys.stdout.flush()
         _sys.stderr.flush()
     except Exception:

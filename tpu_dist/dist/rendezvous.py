@@ -227,6 +227,11 @@ def rendezvous(init_method: Optional[str], world_size: int = -1,
         # code changes needed (tpu_dist/resilience/chaos.py)
         from ..resilience import chaos as _chaos
         chaos_active = _chaos.install_from_env()
+    # flight recorder (tpu_dist.obs; armed via TPU_DIST_OBS / launcher
+    # --flight-recorder): install the crash-dump paths — unhandled
+    # exception, SIGTERM, exit — before anything distributed can hang
+    from ..obs import hooks as _obs_hooks
+    obs_rec = _obs_hooks.install_from_env()
     coordinator, num_processes, process_id = parse_init_method(
         init_method, world_size, rank)
     if chaos_active is not None:
@@ -234,6 +239,12 @@ def rendezvous(init_method: Optional[str], world_size: int = -1,
         # resolved process_id is authoritative (mp.spawn and explicit
         # tcp:// ranks never set RANK)
         chaos_active.rank = process_id
+    if obs_rec is not None:
+        # same correction for the recorder: its rank keys the store tail
+        # (tpu_dist/g{gen}/obs/{rank}) and the dump filename — a guessed
+        # rank 0 would make every rank overwrite the same key and file
+        obs_rec.rank = process_id
+        obs_rec.world = num_processes
     if coordinator is None or num_processes <= 1:
         return
 
@@ -267,6 +278,10 @@ def rendezvous(init_method: Optional[str], world_size: int = -1,
                                num_processes=num_processes,
                                process_id=process_id, **kwargs)
     _distributed_started = True
+    # re-chain the SIGTERM crash-dump handler over whatever handler
+    # jax.distributed may have just installed (preemption notifier): the
+    # chained call preserves jax's behavior, ours adds the dump first
+    _obs_hooks.install_signal_handlers()
 
 
 def shutdown() -> None:

@@ -335,6 +335,51 @@ class _PyClient:
             pass
 
 
+# store ops worth a flight-recorder event; CHECK/NUMKEYS are the polling
+# primitives (a blocked rank fires them at 10ms cadence) and would only
+# flood the ring buffer with what the pending collective span already says
+_OBS_OP_NAMES = {_OP_SET: "set", _OP_GET: "get", _OP_ADD: "add",
+                 _OP_DELETE: "delete", _OP_WAIT_GE: "wait_ge",
+                 _OP_DELETE_PREFIX: "delete_prefix"}
+
+
+class _ObservedClient:
+    """Flight-recorder shim around a store client: one ``kind="store"``
+    event per completed request (op name, key, payload bytes, outcome).
+
+    Installed at :class:`TCPStore` construction only when the recorder is
+    armed (``TPU_DIST_OBS``), so disarmed stores keep the raw client and
+    the hot path pays nothing."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def request(self, op: int, key: str, payload: bytes = b"") -> bytes:
+        t0 = time.monotonic_ns()
+        try:
+            out = self._inner.request(op, key, payload)
+        except BaseException as e:
+            self._rec(op, key, payload, t0, f"error:{type(e).__name__}")
+            raise
+        self._rec(op, key, payload, t0, "ok")
+        return out
+
+    @staticmethod
+    def _rec(op, key, payload, t0, outcome):
+        name = _OBS_OP_NAMES.get(op)
+        if name is None:
+            return
+        try:  # diagnostics must never break a store op
+            from ..obs.recorder import safe_record
+        except Exception:
+            return
+        safe_record("store", name, t0=t0, key=key, bytes=len(payload),
+                    outcome=outcome)
+
+    def close(self):
+        self._inner.close()
+
+
 class _NativeClient:
     """ctypes wrapper over the C++ client in libtpudist.so."""
 
@@ -479,9 +524,13 @@ class TCPStore(Store):
         self.host, self.port = host, port
         self.native = lib is not None
         self._lib = lib  # close() must stop the server with the same lib
-        self._client = (_NativeClient(lib, host, port, timeout)
-                        if lib is not None
-                        else _PyClient(host, port, timeout))
+        client = (_NativeClient(lib, host, port, timeout)
+                  if lib is not None
+                  else _PyClient(host, port, timeout))
+        from ..obs import recorder as _obs_recorder
+        if _obs_recorder.enabled():
+            client = _ObservedClient(client)
+        self._client = client
 
     # -- Store API -----------------------------------------------------------
     def set(self, key: str, value) -> None:

@@ -114,6 +114,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "CollectiveMismatchError within "
                         "TPU_DIST_SANITIZE_TIMEOUT instead of hanging "
                         "(tpu_dist/analysis/sanitizer.py)")
+    p.add_argument("--flight-recorder", "--flight_recorder",
+                   dest="flight_recorder", action="store_true",
+                   help="arm the per-rank collective flight recorder in "
+                        "every worker (TPU_DIST_OBS=1, tpu_dist.obs): a "
+                        "ring buffer of structured events for every host "
+                        "collective / p2p / store op / heartbeat, crash-"
+                        "dumped to TPU_DIST_OBS_DIR on failure and merged "
+                        "into a Chrome trace + hang diagnosis with "
+                        "`python -m tpu_dist.obs` (docs/observability.md). "
+                        "On a failed round the supervisor prints each "
+                        "rank's last known position from the store")
     p.add_argument("--standalone", action="store_true",
                    help="single-node mode with automatic rendezvous "
                         "(torchrun parity): forces --nnodes=1 "
@@ -233,6 +244,9 @@ def _spawn_world(args, world_size: int, master_port: int,
                     args.heartbeat_timeout)
             if args.sanitize:
                 env["TPU_DIST_SANITIZE"] = "1"
+            if getattr(args, "obs_dir", None):
+                env["TPU_DIST_OBS"] = "1"
+                env["TPU_DIST_OBS_DIR"] = args.obs_dir
             cmd = [sys.executable]
             if args.module:
                 cmd += ["-m", args.script]
@@ -252,6 +266,23 @@ def _spawn_world(args, world_size: int, master_port: int,
                 p.wait()
         raise
     return procs
+
+
+def _request_obs_dumps(args, procs: List[subprocess.Popen],
+                       remaining) -> None:
+    """Ask still-alive workers to flush their flight recorders (SIGUSR1 ->
+    tpu_dist.obs dump handler) before the TERM/KILL teardown.  Armed runs
+    only — a worker that never installed the handler would die on USR1,
+    which on this (already failed, about to be TERMed) path is harmless
+    but pointless."""
+    if getattr(args, "obs_dir", None) is None:
+        return
+    for j in remaining:
+        if procs[j].poll() is None:
+            try:
+                procs[j].send_signal(signal.SIGUSR1)
+            except OSError:
+                pass
 
 
 def _watch_world(args, procs: List[subprocess.Popen], store,
@@ -331,6 +362,7 @@ def _watch_world(args, procs: List[subprocess.Popen], store,
                                       str(args.node_rank).encode())
                         except Exception:
                             pass
+                    _request_obs_dumps(args, procs, remaining)
                     for j in remaining:
                         procs[j].terminate()
                     kill_deadline = time.monotonic() + kill_grace
@@ -343,6 +375,7 @@ def _watch_world(args, procs: List[subprocess.Popen], store,
                         sys.stderr.write(
                             "[tpu_dist.launch] another node reported a "
                             "worker failure; stopping local workers\n")
+                        _request_obs_dumps(args, procs, remaining)
                         for j in remaining:
                             procs[j].terminate()
                         kill_deadline = time.monotonic() + kill_grace
@@ -362,6 +395,7 @@ def _watch_world(args, procs: List[subprocess.Popen], store,
                             store.set(fail_key, str(args.node_rank).encode())
                         except Exception:
                             pass
+                    _request_obs_dumps(args, procs, remaining)
                     for j in remaining:
                         procs[j].terminate()
                     kill_deadline = time.monotonic() + kill_grace
@@ -392,6 +426,35 @@ def _watch_world(args, procs: List[subprocess.Popen], store,
         exit_code = 130
         interrupted = True
     return exit_code, interrupted
+
+
+def _report_obs(args, store, world_size: int, rnd: int) -> None:
+    """Per-rank "last known position" table from the flight-recorder tails
+    workers posted under ``tpu_dist/g{rnd}/obs/{rank}`` — printed on a
+    failed round BEFORE the generation's keyspace is reaped, so the
+    operator sees where every rank was without opening a single dump."""
+    if args.obs_dir is not None:
+        sys.stderr.write(
+            f"[tpu_dist.launch] flight-recorder dumps in {args.obs_dir} "
+            f"(merge/diagnose: python -m tpu_dist.obs diagnose --dir "
+            f"{args.obs_dir})\n")
+    if store is None:
+        return
+    from ..obs.hooks import fetch_tail, render_tail
+    rows = [(r, fetch_tail(store, rnd, r)) for r in range(world_size)]
+    if all(t is None for _, t in rows):
+        return  # recorder disarmed (or no tail made it): stay quiet
+    sys.stderr.write(f"[tpu_dist.launch] last known positions "
+                     f"(generation {rnd}):\n")
+    for r, tail in rows:
+        if tail is None:
+            desc = "no obs tail posted"
+        else:
+            try:
+                desc = render_tail(tail)
+            except Exception:
+                desc = str(tail)
+        sys.stderr.write(f"  rank {r}: {desc}\n")
 
 
 def _reset_round_state(store,
@@ -561,6 +624,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "control-plane store; drop --no_store\n")
         return 2
     world_size = args.nproc_per_node * args.nnodes
+    # flight-recorder wiring: --flight-recorder (or an already-armed env)
+    # resolves ONE dump dir shared by supervisor messages and every worker.
+    # The env test MUST be the recorder's own parser: a bare truthiness
+    # check would invert an explicit TPU_DIST_OBS=0 into forced arming.
+    from ..obs.recorder import enabled as _obs_enabled
+    args.obs_dir = None
+    if args.flight_recorder or _obs_enabled():
+        args.obs_dir = (os.environ.get("TPU_DIST_OBS_DIR")
+                        or os.path.join(os.getcwd(), "tpu_dist_obs"))
 
     store, master_port, store_addr = _setup_store(args)
     if master_port is None:
@@ -588,6 +660,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                                                   world_size, rnd=restarts)
             if interrupted:
                 return exit_code
+            if exit_code != 0 and args.node_rank == 0:
+                # before any reaping: the tails live under the failed
+                # generation's keyspace
+                _report_obs(args, store, world_size, restarts)
             if multi_node_elastic:
                 # group decision: even a node whose workers all exited 0
                 # must wait — a peer's failure restarts everyone
@@ -603,7 +679,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                 sys.stderr.write(
                     f"[tpu_dist.launch] world failed; agreed restart "
                     f"{restarts}/{args.max_restarts} across "
-                    f"{args.nnodes} nodes — relaunching\n")
+                    f"{args.nnodes} nodes — relaunching"
+                    + (f" (obs dumps: {args.obs_dir})"
+                       if args.obs_dir else "") + "\n")
                 _restart_backoff(args, restarts)
                 continue
             if exit_code == 0 or restarts >= args.max_restarts:
@@ -612,7 +690,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             sys.stderr.write(
                 f"[tpu_dist.launch] worker failed (rc={exit_code}); "
                 f"restart {restarts}/{args.max_restarts} — relaunching "
-                f"the world\n")
+                f"the world"
+                + (f" (obs dumps: {args.obs_dir})"
+                   if args.obs_dir else "") + "\n")
             if store is not None:
                 _reset_round_state(store, finished_round=restarts - 1)
             _restart_backoff(args, restarts)

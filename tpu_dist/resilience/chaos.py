@@ -16,6 +16,13 @@ Grammar: ``fault[;fault...]`` where ``fault = kind[:k=v[,k=v...]]``.  Kinds:
 ``exit``           ``os._exit(code)`` at ``step`` (default code 1)
 ``raise``          raise :class:`ChaosError` at ``step`` (the exception path
                    through the launcher's fail-fast)
+``stall``          sleep ``delay`` seconds (default 600) at ``step`` while
+                   peers advance — the silent-straggler simulation: the
+                   rank's heartbeats stop too while it is stalled (a
+                   wedged process cannot beat), so the watchdog names it
+                   and the flight recorder's merge shows every peer
+                   waiting on it; a rank that outlives a short stall
+                   resumes beating when its step advances
 ``stall-heartbeat``  stop publishing heartbeats from ``step`` on while the
                    process stays alive — the hung-collective simulation
 ``drop-store``     close the store client socket right before its ``op``-th
@@ -48,9 +55,9 @@ from typing import List, Optional
 __all__ = ["Chaos", "ChaosError", "Fault", "parse", "install",
            "install_from_env", "uninstall", "active"]
 
-_KINDS = ("kill", "exit", "raise", "stall-heartbeat", "drop-store",
+_KINDS = ("kill", "exit", "raise", "stall", "stall-heartbeat", "drop-store",
           "delay-store")
-_STEP_KINDS = ("kill", "exit", "raise", "stall-heartbeat")
+_STEP_KINDS = ("kill", "exit", "raise", "stall", "stall-heartbeat")
 _STORE_KINDS = ("drop-store", "delay-store")
 
 
@@ -139,13 +146,25 @@ class Chaos:
             elif f.kind == "raise":
                 raise ChaosError(
                     f"injected failure on rank {self.rank} at step {step}")
+            elif f.kind == "stall":
+                secs = f.delay if f.delay > 0 else 600.0
+                _log("chaos-stall", rank=self.rank, step=step, seconds=secs)
+                time.sleep(secs)
 
     def heartbeat_stalled(self, step: Optional[int],
                           rank: Optional[int] = None) -> bool:
+        # a `stall`ed rank stops beating too: the simulated wedge must look
+        # like a real one (a truly stuck process cannot service its loop).
+        # stall suppresses only AT its step — while the sleep lasts, the
+        # published step stays pinned there; once the rank recovers and
+        # advances, beats resume (a recovered rank is healthy, not lost).
+        # stall-heartbeat stays `>=`: it simulates a wedge that never ends.
         r = self.rank if rank is None else rank
-        return any(f.kind == "stall-heartbeat"
-                   and (f.rank is None or f.rank == r)
-                   and step is not None and step >= f.step
+        if step is None:
+            return False
+        return any((f.rank is None or f.rank == r)
+                   and ((f.kind == "stall-heartbeat" and step >= f.step)
+                        or (f.kind == "stall" and step == f.step))
                    for f in self.faults)
 
     def store_op(self, client, op: int, key: str) -> None:

@@ -7,14 +7,17 @@ helpers keep the computation in the XLA graph (device reductions, one
 scalar out) and add the standard top-k form.
 
 The collective counters aggregate bytes/latency per (op, transport) for the
-eager host collectives (tpu_dist/collectives/eager.py records into them on
-every call), so a training job can answer "how much gradient traffic rode
-the p2p data plane vs. the store, and at what rate?" without a profiler.
+eager host collectives, so a training job can answer "how much gradient
+traffic rode the p2p data plane vs. the store, and at what rate?" without a
+profiler.  Since the ``tpu_dist.obs`` flight recorder landed, the counters
+live in :mod:`tpu_dist.obs.recorder` — the collectives record into ONE
+ingestion point (``record_transport``) that feeds both the aggregates and
+the armed event stream, so the counters and the flight recorder can never
+disagree.  The three functions below are kept as the stable public API.
 """
 
 from __future__ import annotations
 
-import threading
 from typing import Dict, Sequence, Tuple
 
 import jax
@@ -25,44 +28,32 @@ __all__ = ["topk_accuracy", "accuracy", "confusion_matrix",
            "reset_collective_counters"]
 
 
-# -- host-collective transport counters ---------------------------------------
-
-_coll_mu = threading.Lock()
-_coll_counters: Dict[str, Dict[str, float]] = {}
+# -- host-collective transport counters (shims over tpu_dist.obs) -------------
 
 
 def record_collective(op: str, transport: str, nbytes: int,
                       seconds: float) -> None:
     """Account one eager collective: ``op`` (all_reduce, send, ...) over
-    ``transport`` ('dataplane' | 'store') moving ``nbytes`` of array
-    payload in ``seconds`` of wall time."""
-    key = f"{op}/{transport}"
-    with _coll_mu:
-        c = _coll_counters.get(key)
-        if c is None:
-            c = _coll_counters[key] = {"calls": 0, "bytes": 0, "seconds": 0.0}
-        c["calls"] += 1
-        c["bytes"] += int(nbytes)
-        c["seconds"] += float(seconds)
+    ``transport`` ('dataplane' | 'store' | 'mesh') moving ``nbytes`` of
+    array payload in ``seconds`` of wall time.  Shim over
+    :func:`tpu_dist.obs.recorder.record_transport` — the flight recorder's
+    ingestion point."""
+    from ..obs import recorder as _obs
+    _obs.record_transport(op, transport, nbytes, seconds)
 
 
 def collective_counters(reset: bool = False) -> Dict[str, Dict[str, float]]:
     """Snapshot of the per-``op/transport`` counters, each entry
     ``{calls, bytes, seconds, mb_per_s}``.  ``reset=True`` atomically
-    clears after reading (per-step deltas)."""
-    with _coll_mu:
-        out = {k: dict(v) for k, v in _coll_counters.items()}
-        if reset:
-            _coll_counters.clear()
-    for v in out.values():
-        v["mb_per_s"] = (v["bytes"] / v["seconds"] / 1e6
-                         if v["seconds"] > 0 else 0.0)
-    return out
+    clears after reading (per-step deltas).  Reads the obs event-stream
+    aggregates (:func:`tpu_dist.obs.recorder.transport_counters`)."""
+    from ..obs import recorder as _obs
+    return _obs.transport_counters(reset=reset)
 
 
 def reset_collective_counters() -> None:
-    with _coll_mu:
-        _coll_counters.clear()
+    from ..obs import recorder as _obs
+    _obs.reset_transport_counters()
 
 
 def topk_accuracy(logits, targets, ks: Sequence[int] = (1, 5)):
