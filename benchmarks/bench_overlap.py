@@ -1,0 +1,228 @@
+"""Benchmark: async/bucketed gradient sync vs the per-leaf sync path.
+
+The ISSUE 5 acceptance quantity: throughput of a many-tensor all-reduce
+(a 64-leaf mixed-size float32 "gradient tree") at world 4, across four
+issue disciplines over the SAME p2p ring data plane:
+
+- **per_leaf_sync** — one blocking ``all_reduce_host`` per leaf (the
+  pre-async behavior: 64 sequential ring collectives, each paying full
+  2(N-1)-step ring latency);
+- **per_leaf_async** — one ``async_op=True`` handle per leaf, issued
+  back-to-back and waited together (``wait_all``): the ordered engine
+  pipelines issue against wire time but the wire still sees 64 small
+  collectives;
+- **tree_sync** — one blocking tree call (per-leaf ring routing for large
+  leaves + one batched store round for small ones, the PR 2 behavior);
+- **bucketed_async** — :class:`tpu_dist.collectives.Bucketer`: leaves
+  coalesce into 25 MiB chunk-major buckets issued as async ring
+  all-reduces (the DDP Reducer discipline).
+
+MB/s is input payload bytes (sum of leaf nbytes) per second of wall time
+for the whole tree sync.  Workers are wired exactly like
+benchmarks/bench_host_collectives.py (store + rank shim, no XLA).  Prints
+one BENCH JSON line per measurement::
+
+    {"metric": "grad_sync", "mode": "bucketed_async", "world": 4,
+     "leaves": 64, "bytes": 9586688, "value": 31.2, "unit": "MB/s"}
+
+plus a ``bucketed_async_vs_per_leaf_sync_w4`` summary line (acceptance:
+>= 1.5).  ``--smoke`` runs world 2 with a small tree and cross-checks the
+bucketed result bitwise against the per-leaf ring — wired as a tier-1 test
+(tests/test_async_collectives.py) so the async engine is exercised on
+every PR.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_MODES = ("per_leaf_sync", "per_leaf_async", "tree_sync", "bucketed_async")
+
+
+def _leaf_sizes(smoke: bool):
+    """The 64-leaf mixed-size tree (element counts): mostly small-to-medium
+    leaves (biases, norms, small kernels) plus a few large ones (embedding/
+    dense kernels) — the shape DDP bucketing exists for."""
+    if smoke:
+        return [257, 1024, 4099, 16384] * 4            # 16 leaves, ~350 KB
+    sizes = [1024, 4099, 16384, 65537] * 15            # 60 leaves
+    sizes += [262144] * 4                              # 4 big kernels
+    return sizes                                       # 64 leaves, ~9.6 MB
+
+
+# ---------------------------------------------------------------------------
+# worker
+# ---------------------------------------------------------------------------
+
+def _worker() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    from tpu_dist.dist.store import TCPStore
+
+    rank = int(os.environ["RANK"])
+    world = int(os.environ["WORLD_SIZE"])
+    spec = json.loads(os.environ["BENCH_SPEC"])
+    host, _, port = os.environ["TPU_DIST_STORE_ADDR"].rpartition(":")
+    store = TCPStore(host, int(port))
+    rdzv = importlib.import_module("tpu_dist.dist.rendezvous")
+    rdzv._store = store
+
+    class _Group:
+        def __init__(self, rank, num_processes):
+            self.rank, self.num_processes = rank, num_processes
+
+    g = _Group(rank, world)
+    from tpu_dist import collectives as C
+
+    # every leaf rides the ring: the comparison is issue discipline, not
+    # transport routing
+    os.environ["TPU_DIST_DP_THRESHOLD"] = "0"
+    sizes = spec["sizes"]
+    tree = {f"leaf{i:03d}": (np.random.default_rng(1000 * (rank + 1) + i)
+                             .standard_normal(n).astype(np.float32))
+            for i, n in enumerate(sizes)}
+    leaves = list(tree.values())
+    nbytes = sum(a.nbytes for a in leaves)
+    bucketer = C.Bucketer()
+
+    def run_mode(mode):
+        if mode == "per_leaf_sync":
+            return [C.all_reduce_host(a, group=g, op="avg") for a in leaves]
+        if mode == "per_leaf_async":
+            works = [C.all_reduce_host(a, group=g, op="avg", async_op=True)
+                     for a in leaves]
+            return C.wait_all(works, timeout=600)
+        if mode == "tree_sync":
+            return C.all_reduce_host(tree, group=g, op="avg")
+        if mode == "bucketed_async":
+            return bucketer.all_reduce(tree, op="avg",
+                                       group=g).wait_all(timeout=600)
+        raise ValueError(mode)
+
+    if spec.get("check"):
+        # bucketed result must be BITWISE equal to the per-leaf ring path
+        ref = run_mode("per_leaf_sync")
+        got = run_mode("bucketed_async")
+        for a, (k, b) in zip(ref, sorted(got.items())):
+            assert np.asarray(a).tobytes() == np.asarray(b).tobytes(), \
+                f"bucketed != per-leaf for {k}"
+
+    rows = []
+    for mode in _MODES:
+        run_mode(mode)  # warm-up: peer connections, engine thread
+        store.barrier(world, tag=f"bench-{mode}")
+        t0 = time.perf_counter()
+        for _ in range(spec["iters"]):
+            run_mode(mode)
+        dt = time.perf_counter() - t0
+        rows.append({"metric": "grad_sync", "mode": mode, "world": world,
+                     "leaves": len(leaves), "bytes": nbytes,
+                     "iters": spec["iters"],
+                     "value": round(nbytes * spec["iters"] / dt / 1e6, 2),
+                     "unit": "MB/s"})
+    if rank == 0:
+        with open(os.environ["BENCH_OUT"], "w") as f:
+            json.dump(rows, f)
+    store.barrier(world, tag="bench-exit")
+    store.close()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def _run_world(world: int, smoke: bool, iters: int, out_path: str):
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from tpu_dist.dist.store import TCPStore
+
+    store = TCPStore(is_master=True)
+    procs = []
+    try:
+        env = dict(os.environ,
+                   TPU_DIST_STORE_ADDR=f"127.0.0.1:{store.port}",
+                   WORLD_SIZE=str(world),
+                   PYTHONPATH=_REPO + os.pathsep + os.environ.get(
+                       "PYTHONPATH", ""),
+                   JAX_PLATFORMS="cpu",
+                   BENCH_OUT=out_path,
+                   BENCH_SPEC=json.dumps({"sizes": _leaf_sizes(smoke),
+                                          "iters": iters, "check": smoke}))
+        env.pop("TPU_DIST_RESTART_COUNT", None)
+        env.pop("TPU_DIST_DP_THRESHOLD", None)
+        procs = [subprocess.Popen(
+            [sys.executable, "-m", "benchmarks.bench_overlap", "--worker"],
+            env=dict(env, RANK=str(r)), cwd=_REPO)
+            for r in range(world)]
+        deadline = time.monotonic() + 600
+        rcs = [p.wait(timeout=max(1, deadline - time.monotonic()))
+               for p in procs]
+        if any(rcs):
+            raise RuntimeError(f"bench workers failed: rcs={rcs}")
+    finally:
+        for p in procs:  # a hung/failed world must not leak workers
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        store.close()
+    with open(out_path) as f:
+        return json.load(f)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--smoke", action="store_true",
+                    help="world=2, 16-leaf tree, bitwise bucketed-vs-"
+                         "per-leaf cross-check; seconds (tier-1)")
+    ap.add_argument("--worlds", type=int, nargs="*", default=None)
+    ap.add_argument("--iters", type=int, default=0,
+                    help="per-mode iterations (0 = auto)")
+    args = ap.parse_args(argv)
+    if args.worker:
+        return _worker()
+
+    worlds = args.worlds or ([2] if args.smoke else [2, 4])
+    iters = args.iters or (2 if args.smoke else 4)
+    all_rows = []
+    import tempfile
+    for world in worlds:
+        with tempfile.NamedTemporaryFile(mode="w", suffix=".json",
+                                         delete=False) as tmp:
+            out_path = tmp.name
+        try:
+            rows = _run_world(world, args.smoke, iters, out_path)
+        finally:
+            try:
+                os.unlink(out_path)
+            except OSError:
+                pass
+        for row in rows:
+            if args.smoke:
+                row["smoke"] = True
+            print(json.dumps(row))
+        all_rows.extend(rows)
+
+    # the ISSUE 5 acceptance quantity, when its configuration was measured
+    by_key = {(r["mode"], r["world"]): r["value"] for r in all_rows}
+    bucketed = by_key.get(("bucketed_async", 4))
+    per_leaf = by_key.get(("per_leaf_sync", 4))
+    if bucketed and per_leaf:
+        print(json.dumps({"metric": "bucketed_async_vs_per_leaf_sync_w4",
+                          "value": round(bucketed / per_leaf, 2),
+                          "unit": "x", "threshold": 1.5}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, _REPO)
+    sys.exit(main())

@@ -19,9 +19,12 @@ relaunch, and resume from the latest checkpoint with an identical loss
 trajectory (batches are keyed on the global step).  See docs/resilience.md
 for the failure model.
 
-Gradient averaging uses the eager store-transport gather/scatter, which
-works on any backend — including CPU test rigs where XLA has no
-multiprocess computations; on real TPU slices prefer the fused in-step
+Gradient averaging uses the bucketed ASYNC host collectives
+(:class:`tpu_dist.collectives.Bucketer`): gradient leaves coalesce into
+flat buckets issued as asynchronous ring all-reduces over the p2p data
+plane, so the host work between issue and ``wait_all`` overlaps the sync —
+and it works on any backend, including CPU test rigs where XLA has no
+multiprocess computations.  On real TPU slices prefer the fused in-step
 all-reduce (`tpu_dist.parallel.DistributedDataParallel`).
 """
 
@@ -91,6 +94,7 @@ def main():
 
     log = MetricLogger(every=25, fmt="[elastic] step {step} loss {loss:.4f}")
     params0 = model.init(jax.random.PRNGKey(0))
+    bucketer = C.Bucketer()  # bucketed async grad sync (25 MiB buckets)
     with resilience.TrainState(args.ckpt_root, save_every=args.save_every,
                                keep=3) as ts:
         state, start = ts.resume({"params": params0,
@@ -101,18 +105,17 @@ def main():
         for step in range(start, args.max_steps):
             x, y = batch(step)
             l, g = fwd_bwd(params, x, y)
-            if nproc > 1:  # average grads via the store transport
-                g = jax.tree.map(np.asarray, g)
-                gathered = C.gather_host(g, dst=0, group=pg)
-                if rank == 0:
-                    avg = jax.tree.map(
-                        lambda *xs: (np.sum(xs, axis=0) / nproc)
-                        .astype(np.float32), *gathered)
-                    g = C.scatter_host(g, [avg] * nproc, src=0, group=pg)
-                else:
-                    g = C.scatter_host(g, None, src=0, group=pg)
+            if nproc > 1:
+                # issue the bucketed async all-reduce, then overlap the
+                # loss readback (a device sync) with the wire transfer
+                work = bucketer.all_reduce(jax.tree.map(np.asarray, g),
+                                           op="avg", group=pg)
+                loss_now = float(l)
+                g = work.wait_all(timeout=300)
+            else:
+                loss_now = float(l)
             params, opt_state = opt.update(g, opt_state, params)
-            log.push(step=step, loss=float(l))
+            log.push(step=step, loss=loss_now)
             ts.end_step({"params": params, "opt": opt_state}, step)
     rank_zero_print(f"[elastic] done at step {args.max_steps}")
     dist.destroy_process_group()

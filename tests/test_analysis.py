@@ -144,6 +144,25 @@ class T:
 """
 
 
+TD007_POS = """
+def sync_grads(g, group):
+    C.all_reduce_host(g, group=group, op="avg", async_op=True)
+    return g
+"""
+
+TD007_NEG = """
+def sync_grads(g, group):
+    w = C.all_reduce_host(g, group=group, op="avg", async_op=True)
+    return w.wait(timeout=300)
+"""
+
+TD007_ASSIGNED_UNUSED = """
+def sync_grads(g, group, bucketer):
+    handle = bucketer.all_reduce(g, op="avg", group=group)
+    return g
+"""
+
+
 class TestRules:
     @pytest.mark.parametrize("rule,pos,neg", [
         ("TD001", TD001_POS, TD001_NEG),
@@ -152,6 +171,7 @@ class TestRules:
         ("TD004", TD004_POS, TD004_NEG),
         ("TD005", TD005_POS, TD005_NEG),
         ("TD006", TD006_POS, TD006_NEG),
+        ("TD007", TD007_POS, TD007_NEG),
     ])
     def test_positive_flags_negative_passes(self, rule, pos, neg):
         assert rule in _rules(lint_source(pos, f"{rule}_pos.py")), \
@@ -203,7 +223,27 @@ class TestRules:
 
     def test_rule_docs_cover_all_codes(self):
         assert sorted(RULE_DOCS) == ["TD001", "TD002", "TD003", "TD004",
-                                     "TD005", "TD006"]
+                                     "TD005", "TD006", "TD007"]
+
+    def test_td007_assigned_then_unused_handle(self):
+        found = lint_source(TD007_ASSIGNED_UNUSED, "t.py")
+        assert _rules(found) == ["TD007"]
+        assert "handle `handle`" in found[0].message
+
+    def test_td007_sync_call_and_used_handle_pass(self):
+        src = textwrap.dedent("""
+            def sync_grads(g, group, works):
+                C.all_reduce_host(g, group=group, op="avg")   # sync: fine
+                w = C.broadcast_host(g, group=group, async_op=True)
+                works.append(w)                               # use: fine
+                h = C.recv(src=1, group=group, async_op=True)
+                return h.wait(timeout=300)
+        """)
+        assert _rules(lint_source(src, "t.py")) == []
+
+    def test_td007_bare_expression_is_error(self):
+        (f,) = lint_source(TD007_POS, "t.py")
+        assert f.severity == "error" and "async_op=True" in f.message
 
     def test_syntax_error_is_td000(self):
         (f,) = lint_source("def broken(:\n", "bad.py")

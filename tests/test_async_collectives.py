@@ -1,0 +1,562 @@
+"""Async collective engine (ISSUE 5): Work futures, ordered-engine
+semantics, the double-buffered pipelined ring, the gradient bucketer's
+bitwise parity with the per-leaf ring, and the overlap benchmark smoke.
+
+In-process halves use the test_ring_collectives wiring (one TCPStore, one
+DataPlane per fake rank, each driven by a thread, per-rank ordered engines
+keyed by plane); the eager ``async_op`` semantics run in spawned worker
+processes because the eager layer's sequence counters and engine are
+process-global by design.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = [pytest.mark.collectives, pytest.mark.multiprocess]
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# Work / ordered-engine units (no sockets)
+# ---------------------------------------------------------------------------
+
+class TestWork:
+    def test_fifo_order_and_results(self):
+        from tpu_dist.collectives.work import _OrderedExecutor
+        eng = _OrderedExecutor()
+        order = []
+
+        def body(i):
+            order.append(i)
+            return i * 10
+
+        works = [eng.submit(lambda i=i: body(i), label=f"w{i}")
+                 for i in range(8)]
+        assert [w.wait(timeout=30) for w in works] == \
+            [i * 10 for i in range(8)]
+        assert order == list(range(8))  # executed in issue order
+
+    def test_wait_timeout_then_completes(self):
+        from tpu_dist.collectives.work import _OrderedExecutor
+        eng = _OrderedExecutor()
+        release = threading.Event()
+        w = eng.submit(lambda: (release.wait(30), "done")[1], label="slow")
+        with pytest.raises(TimeoutError, match="slow"):
+            w.wait(timeout=0.1)
+        assert not w.is_completed()
+        assert w.exception() is None      # pending, not failed
+        release.set()
+        assert w.wait(timeout=30) == "done"
+        assert w.is_completed()
+
+    def test_error_captured_and_reraised_at_wait(self):
+        from tpu_dist.collectives.transport import PeerGoneError
+        from tpu_dist.collectives.work import _OrderedExecutor
+        eng = _OrderedExecutor()
+
+        def boom():
+            raise PeerGoneError(3, "injected")
+
+        w = eng.submit(boom, label="doomed")
+        # the error must not leak out of the executor thread; it is
+        # captured on the handle and re-raised HERE
+        with pytest.raises(PeerGoneError, match="rank 3"):
+            w.wait(timeout=30)
+        assert w.is_completed()
+        assert isinstance(w.exception(), PeerGoneError)
+        # later works on the same engine still run
+        assert eng.submit(lambda: 7).wait(timeout=30) == 7
+
+    def test_completed_work_and_wait_all(self):
+        from tpu_dist.collectives.work import completed_work, wait_all
+        works = [completed_work(i) for i in range(3)]
+        assert all(w.is_completed() for w in works)
+        assert wait_all(works, timeout=1) == [0, 1, 2]
+
+    def test_wait_all_timeout_zero_means_poll_not_forever(self):
+        # timeout=0 = "give it zero time": must raise, not hang (the
+        # single-handle Work.wait(0) contract, uniformly)
+        from tpu_dist.collectives.work import (_OrderedExecutor,
+                                               completed_work, wait_all)
+        gate = threading.Event()
+        eng = _OrderedExecutor()
+        pending = eng.submit(lambda: gate.wait(10), label="parked")
+        with pytest.raises(TimeoutError):
+            wait_all([completed_work(1), pending], timeout=0)
+        assert not eng.drain(timeout=0)
+        gate.set()
+        pending.wait(timeout=30)
+
+    def test_queue_wait_split_lands_on_span(self, monkeypatch):
+        # the span a collective opens while executing on the engine must
+        # carry queue_ns = time spent behind earlier works
+        monkeypatch.setenv("TPU_DIST_OBS", "1")
+        from tpu_dist.obs import recorder as obs_recorder
+        obs_recorder.reset()
+        from tpu_dist.obs.hooks import collective_span
+        from tpu_dist.collectives.work import _OrderedExecutor
+        eng = _OrderedExecutor()
+        gate = threading.Event()
+        eng.submit(lambda: gate.wait(30), label="blocker")
+        spans = []
+
+        def body():
+            with collective_span("test_op") as ev:
+                spans.append(ev)
+            return True
+
+        w = eng.submit(body, label="queued")
+        time.sleep(0.25)          # let it sit queued behind the blocker
+        gate.set()
+        assert w.wait(timeout=30) is True
+        (ev,) = spans
+        assert ev.get("queue_ns", 0) >= 0.2e9, ev
+        obs_recorder.reset()
+
+
+# ---------------------------------------------------------------------------
+# transport: dual recv, vectored send, socket tuning
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def store():
+    from tpu_dist.dist.store import TCPStore
+    s = TCPStore(is_master=True)
+    yield s
+    s.close()
+
+@pytest.fixture
+def dp_pair(store):
+    from tpu_dist.collectives.transport import DataPlane
+    dp0 = DataPlane(store, 0, 2)
+    dp1 = DataPlane(store, 1, 2)
+    yield dp0, dp1
+    dp0.close()
+    dp1.close()
+
+
+class TestTransportAsync:
+    def test_recv_array_dual_frame_wakeup(self, dp_pair):
+        dp0, dp1 = dp_pair
+
+        def late_send():
+            time.sleep(0.2)
+            dp0.send_array(1, "dual", np.arange(5))
+
+        t = threading.Thread(target=late_send)
+        t.start()
+        t0 = time.monotonic()
+        path, arr = dp1.recv_array_dual(0, "dual", timeout=30)
+        dt = time.monotonic() - t0
+        t.join()
+        assert path == "dataplane" and arr[4] == 4
+        # CV wakeup: delivery is prompt, not quantized to a poll interval
+        assert dt < 5.0
+
+    def test_recv_array_dual_alt_transport(self, dp_pair):
+        dp0, dp1 = dp_pair
+        hits = []
+
+        def alt():
+            hits.append(1)
+            return len(hits) >= 3   # "store key" appears on the 3rd poll
+
+        path, arr = dp1.recv_array_dual(0, "never", alt_check=alt,
+                                        timeout=30)
+        assert path == "alt" and arr is None
+        assert len(hits) >= 3       # polled between CV waits, backed off
+
+    def test_recv_array_dual_timeout(self, dp_pair):
+        dp0, dp1 = dp_pair
+        with pytest.raises(TimeoutError, match="rank 0"):
+            dp1.recv_array_dual(0, "nothing", timeout=0.3)
+
+    def test_sock_buf_negotiated_and_recorded(self, store, monkeypatch):
+        # TPU_DIST_SOCK_BUF sizes both buffers; the peer-connect obs event
+        # records what the kernel actually granted
+        monkeypatch.setenv("TPU_DIST_SOCK_BUF", str(1 << 20))
+        monkeypatch.setenv("TPU_DIST_OBS", "1")
+        from tpu_dist.obs import recorder as obs_recorder
+        obs_recorder.reset()
+        from tpu_dist.collectives.transport import DataPlane
+        dp0 = DataPlane(store, 0, 2)
+        dp1 = DataPlane(store, 1, 2)
+        try:
+            big = np.arange(1 << 18, dtype=np.float32)
+            dp0.send_array(1, "buf", big)
+            got = dp1.recv_array(0, "buf", timeout=30)
+            np.testing.assert_array_equal(got, big)
+            rec = obs_recorder.get_recorder()
+            evs = [e for e in rec.snapshot()
+                   if e["kind"] == "transport" and e["op"] == "peer-connect"]
+            assert evs, "no peer-connect event recorded"
+            for e in evs:
+                # kernels clamp/double requests; granted must be real and
+                # at least the OS floor
+                assert e.get("sndbuf", 0) > 0 and e.get("rcvbuf", 0) > 0, e
+        finally:
+            dp0.close()
+            dp1.close()
+            obs_recorder.reset()
+
+
+# ---------------------------------------------------------------------------
+# pipelined ring + bucketer (in-process thread worlds)
+# ---------------------------------------------------------------------------
+
+def _run_world(store, n, fn):
+    from tpu_dist.collectives.transport import DataPlane
+    dps = [DataPlane(store, r, n) for r in range(n)]
+    out, errs = [None] * n, []
+
+    def run(r):
+        try:
+            out[r] = fn(dps[r], r)
+        except Exception as e:
+            errs.append((r, e))
+
+    threads = [threading.Thread(target=run, args=(r,)) for r in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    for dp in dps:
+        dp.close()
+    assert not errs, errs
+    return out
+
+
+class TestPipelinedRing:
+    @pytest.mark.parametrize("world", [2, 3])
+    def test_tiny_subchunks_exercise_interleave(self, store, world,
+                                                monkeypatch):
+        # 4 KiB sub-frames over a 10007-element payload: dozens of frames
+        # per ring step, so the send/fold interleave path runs for real
+        monkeypatch.setenv("TPU_DIST_DP_CHUNK", "4096")
+        from tpu_dist.collectives import ring
+        vals = [np.random.default_rng(r).standard_normal(10007)
+                .astype(np.float32) for r in range(world)]
+        outs = _run_world(
+            store, world,
+            lambda dp, r: ring.ring_all_reduce(dp, vals[r], op="sum",
+                                               tag="pipe"))
+        ref = np.sum(np.stack(vals), axis=0)
+        for o in outs:
+            np.testing.assert_allclose(o, ref, rtol=2e-6, atol=1e-5)
+        assert len({o.tobytes() for o in outs}) == 1
+
+    def test_custom_bounds_match_default_partition(self, store):
+        # explicit bounds equal to the default partition must be a no-op
+        from tpu_dist.collectives import ring
+        n = 3
+        vals = [np.random.default_rng(10 + r).standard_normal(1001)
+                .astype(np.float32) for r in range(n)]
+        default = _run_world(
+            store, n, lambda dp, r: ring.ring_all_reduce(dp, vals[r],
+                                                         op="sum", tag="d"))
+        bounds = ring._bounds(1001, n)
+        custom = _run_world(
+            store, n, lambda dp, r: ring.ring_all_reduce(
+                dp, vals[r], op="sum", tag="c", bounds=bounds))
+        for a, b in zip(default, custom):
+            assert a.tobytes() == b.tobytes()
+
+    def test_bounds_validation(self, dp_pair):
+        from tpu_dist.collectives import ring
+        dp0, _ = dp_pair
+        with pytest.raises(ValueError, match="contiguous spans"):
+            ring.ring_all_reduce(dp0, np.zeros(10, np.float32),
+                                 bounds=[(0, 4), (5, 10)])
+
+
+class TestBucketerBitwise:
+    """THE bucketer contract: bit-identical to the unbucketed per-leaf
+    ring, per element — f32 and bf16, uneven leaves, worlds 2-4, multiple
+    buckets (tiny bucket_bytes), sum and avg."""
+
+    @pytest.mark.parametrize("world", [2, 3, 4])
+    @pytest.mark.parametrize("op", ["sum", "avg"])
+    def test_bitwise_equal_to_per_leaf(self, store, world, op):
+        import ml_dtypes
+        from tpu_dist.collectives import ring
+        from tpu_dist.collectives.bucketer import Bucketer
+
+        def make_tree(r):
+            g = np.random.default_rng(100 + r)
+            return {
+                "w1": g.standard_normal(1001).astype(np.float32),   # uneven
+                "w2": g.standard_normal((7, 13)).astype(np.float32),
+                "w3": g.standard_normal(509).astype(ml_dtypes.bfloat16),
+                "w4": g.standard_normal(3).astype(np.float32),      # < world
+                "b": np.float32(g.standard_normal()),               # scalar
+            }
+
+        trees = [make_tree(r) for r in range(world)]
+
+        def bucketed(dp, r):
+            # 4 KiB buckets force several buckets per dtype stream
+            bk = Bucketer(bucket_bytes=4096, dp=dp)
+            return bk.all_reduce(trees[r], op=op).wait_all(timeout=120)
+
+        def per_leaf(dp, r):
+            import jax
+            leaves, td = jax.tree.flatten(trees[r])
+            red = [ring.ring_all_reduce(dp, l, op=op, tag=f"pl{i}")
+                   for i, l in enumerate(leaves)]
+            return jax.tree.unflatten(td, red)
+
+        got = _run_world(store, world, bucketed)
+        ref = _run_world(store, world, per_leaf)
+        for g_tree, r_tree in zip(got, ref):
+            for k in r_tree:
+                a, b = np.asarray(g_tree[k]), np.asarray(r_tree[k])
+                assert a.dtype == b.dtype, (k, a.dtype, b.dtype)
+                assert a.shape == b.shape, (k, a.shape, b.shape)
+                assert a.tobytes() == b.tobytes(), \
+                    f"world {world} op {op} leaf {k} not bitwise-equal"
+        # and across ranks (the chaos-resume determinism property)
+        for k in got[0]:
+            assert len({np.asarray(t[k]).tobytes() for t in got}) == 1
+
+    def test_comm_dtype_compressed_bitwise(self, store):
+        # wire compression re-quantizes at the chunk owner; identical
+        # chunk ownership keeps bucketed == per-leaf even then
+        from tpu_dist.collectives import ring
+        from tpu_dist.collectives.bucketer import Bucketer
+        world = 2
+        trees = [{"a": np.random.default_rng(r).standard_normal(801)
+                  .astype(np.float32),
+                  "b": np.random.default_rng(50 + r).standard_normal(77)
+                  .astype(np.float32)} for r in range(world)]
+
+        def bucketed(dp, r):
+            bk = Bucketer(bucket_bytes=1 << 20, dp=dp,
+                          comm_dtype="bfloat16")
+            return bk.all_reduce(trees[r], op="sum").wait_all(timeout=60)
+
+        def per_leaf(dp, r):
+            import jax
+            leaves, td = jax.tree.flatten(trees[r])
+            red = [ring.ring_all_reduce(dp, l, op="sum", tag=f"cd{i}",
+                                        comm_dtype="bfloat16")
+                   for i, l in enumerate(leaves)]
+            return jax.tree.unflatten(td, red)
+
+        got = _run_world(store, world, bucketed)
+        ref = _run_world(store, world, per_leaf)
+        for g_tree, r_tree in zip(got, ref):
+            for k in r_tree:
+                assert np.asarray(g_tree[k]).tobytes() == \
+                    np.asarray(r_tree[k]).tobytes()
+
+    def test_issue_time_snapshot_allows_mutation_after_issue(self, store):
+        # leaves are packed on the caller thread at issue: clobbering the
+        # gradient arrays right after all_reduce() returns must not affect
+        # the reduction (no torch-style don't-touch-until-wait hazard)
+        from tpu_dist.collectives.bucketer import Bucketer
+        world = 2
+        base = [np.full(1001, float(r + 1), np.float32)
+                for r in range(world)]
+
+        def run(dp, r):
+            t = {"g": base[r].copy()}
+            w = Bucketer(bucket_bytes=1 << 20, dp=dp).all_reduce(t, op="sum")
+            t["g"][:] = -999.0   # mutate AFTER issue
+            return w.wait_all(timeout=60)
+
+        outs = _run_world(store, world, run)
+        for o in outs:
+            np.testing.assert_array_equal(
+                o["g"], np.full(1001, 3.0, np.float32))
+
+    def test_single_process_fast_path(self):
+        from tpu_dist.collectives.bucketer import Bucketer
+
+        class _G:
+            rank, num_processes = 0, 1
+
+        tree = {"a": np.arange(5, dtype=np.float32)}
+        w = Bucketer().all_reduce(tree, op="avg", group=_G())
+        tree["a"][:] = -1.0   # snapshot contract holds at world 1 too
+        out = w.wait_all(timeout=10)
+        np.testing.assert_array_equal(out["a"],
+                                      np.arange(5, dtype=np.float32))
+
+    def test_pinned_mode_rejects_unsupported_leaves(self, dp_pair):
+        from tpu_dist.collectives.bucketer import Bucketer
+        dp0, _ = dp_pair
+        with pytest.raises(ValueError, match="ring-only"):
+            Bucketer(dp=dp0).all_reduce(
+                {"s": np.array(["x", "y"])}, op="sum")
+
+
+# ---------------------------------------------------------------------------
+# eager async_op semantics (spawned world 2)
+# ---------------------------------------------------------------------------
+
+_WORKER_PRELUDE = textwrap.dedent("""
+    import importlib, json, os, sys
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["TPU_DIST_DP_THRESHOLD"] = "0"
+    import numpy as np
+
+    rank = int(os.environ["RANK"]); world = int(os.environ["WORLD_SIZE"])
+    from tpu_dist.dist.store import TCPStore
+    host, _, port = os.environ["TPU_DIST_STORE_ADDR"].rpartition(":")
+    store = TCPStore(host, int(port))
+    rdzv = importlib.import_module("tpu_dist.dist.rendezvous")
+    rdzv._store = store
+
+    class _Group:
+        def __init__(self, rank, num_processes):
+            self.rank, self.num_processes = rank, num_processes
+    g = _Group(rank, world)
+    from tpu_dist import collectives as C
+""")
+
+_ASYNC_SEMANTICS_WORKER = _WORKER_PRELUDE + textwrap.dedent("""
+    x1 = np.full(5000, float(rank + 1), np.float32)
+    x2 = np.arange(3000, dtype=np.float32) * (rank + 1)
+
+    # two async all-reduces + a broadcast issue back-to-back; results are
+    # FIFO-consistent and equal to the sync path
+    w1 = C.all_reduce_host(x1, group=g, op="sum", async_op=True)
+    x1[:] = -777.0   # inputs are snapshotted at issue: mutation is safe
+    w2 = C.all_reduce_host(x2, group=g, op="avg", async_op=True)
+    bc_in = (np.full(5000, float(rank + 1), np.float32) if rank == 0
+             else np.zeros(5000, np.float32))
+    wb = C.broadcast_host(bc_in, group=g, src=0, async_op=True)
+    # a SYNC collective issued after async work drains the queue first:
+    # by the time it runs, w1/w2/wb must already be complete
+    sync = C.all_gather_host(np.float32(rank), group=g)
+    assert w1.is_completed() and w2.is_completed() and wb.is_completed(), \\
+        "sync collective overtook queued async work"
+
+    total = sum(r + 1 for r in range(world))
+    np.testing.assert_allclose(w1.wait(timeout=60),
+                               np.full(5000, total, np.float32))
+    np.testing.assert_allclose(
+        w2.wait(timeout=60),
+        np.arange(3000, dtype=np.float32) * (total / world))
+    np.testing.assert_allclose(wb.wait(timeout=60),
+                               np.full(5000, 1.0, np.float32))
+    assert sync.shape == (world,)
+
+    # async send/recv (isend/irecv flavor)
+    if rank == 0:
+        hs = C.send(np.arange(2000, dtype=np.float32), dst=1, group=g,
+                    async_op=True)
+        assert hs.wait(timeout=60) is None
+    else:
+        hr = C.recv(src=0, group=g, async_op=True)
+        got = hr.wait(timeout=60)
+        np.testing.assert_array_equal(got,
+                                      np.arange(2000, dtype=np.float32))
+
+    store.barrier(world, tag="done")
+    with open(sys.argv[1] + f"/result{rank}.json", "w") as f:
+        json.dump({"ok": True}, f)
+    store.close()
+""")
+
+_ASYNC_PEER_DEATH_WORKER = _WORKER_PRELUDE + textwrap.dedent("""
+    if rank == 1:
+        # participate in ONE collective so rank 0's plane knows us, then
+        # die with the second collective owed
+        C.all_reduce_host(np.full(4096, 1.0, np.float32), group=g, op="sum")
+        store.close()
+        os._exit(0)
+
+    C.all_reduce_host(np.full(4096, 1.0, np.float32), group=g, op="sum")
+    w = C.all_reduce_host(np.full(4096, 2.0, np.float32), group=g,
+                          op="sum", async_op=True)
+    # the error is captured while the work executes; wait() re-raises it
+    # on THIS thread, naming the dead peer
+    from tpu_dist.collectives.transport import PeerGoneError
+    try:
+        w.wait(timeout=120)
+        raise SystemExit("expected PeerGoneError at wait()")
+    except PeerGoneError as e:
+        assert "rank 1" in str(e), str(e)
+        assert isinstance(w.exception(), PeerGoneError)
+    with open(sys.argv[1] + "/result0.json", "w") as f:
+        json.dump({"ok": True, "error": "PeerGoneError"}, f)
+    store.close()
+""")
+
+
+def _spawn_world(tmp_path, source, world, timeout=180):
+    from tpu_dist.dist.store import TCPStore
+    script = tmp_path / "worker.py"
+    script.write_text(source)
+    server = TCPStore(is_master=True)
+    env = dict(os.environ,
+               PYTHONPATH=_REPO + os.pathsep + os.environ.get("PYTHONPATH",
+                                                              ""),
+               JAX_PLATFORMS="cpu",
+               TPU_DIST_STORE_ADDR=f"127.0.0.1:{server.port}",
+               WORLD_SIZE=str(world))
+    env.pop("TPU_DIST_RESTART_COUNT", None)
+    env.pop("TPU_DIST_DP_THRESHOLD", None)
+    try:
+        procs = [subprocess.Popen(
+            [sys.executable, str(script), str(tmp_path)],
+            env=dict(env, RANK=str(r)), cwd=_REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+            for r in range(world)]
+        outs = [p.communicate(timeout=timeout) for p in procs]
+        rcs = [p.returncode for p in procs]
+    finally:
+        server.close()
+    assert rcs == [0] * world, "\n\n".join(
+        f"rank {r} rc={rc}\nstdout:\n{o}\nstderr:\n{e}"
+        for r, (rc, (o, e)) in enumerate(zip(rcs, outs)) if rc != 0)
+    return [json.loads((tmp_path / f"result{r}.json").read_text())
+            if (tmp_path / f"result{r}.json").exists() else None
+            for r in range(world)]
+
+
+def test_eager_async_op_semantics(tmp_path):
+    """async_op=True returns Work futures equal to the sync results, FIFO
+    ordering holds, a sync collective drains queued async work, and async
+    send/recv round-trip."""
+    res = _spawn_world(tmp_path, _ASYNC_SEMANTICS_WORKER, 2)
+    assert all(r == {"ok": True} for r in res)
+
+
+def test_async_error_captured_at_issue_raised_at_wait(tmp_path):
+    """A peer dying mid-async-collective surfaces as PeerGoneError at
+    wait(), naming the dead rank — not an unraisable error on the engine
+    thread."""
+    res = _spawn_world(tmp_path, _ASYNC_PEER_DEATH_WORKER, 2)
+    assert res[0] == {"ok": True, "error": "PeerGoneError"}
+
+
+# ---------------------------------------------------------------------------
+# the overlap benchmark's smoke mode IS a tier-1 test (ISSUE 5 CI gate)
+# ---------------------------------------------------------------------------
+
+def test_bench_overlap_smoke():
+    env = dict(os.environ,
+               PYTHONPATH=_REPO + os.pathsep + os.environ.get("PYTHONPATH",
+                                                              ""),
+               JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "benchmarks.bench_overlap", "--smoke"],
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    rows = [json.loads(line) for line in r.stdout.strip().splitlines()]
+    by_mode = {row["mode"]: row["value"] for row in rows
+               if row.get("metric") == "grad_sync"}
+    for mode in ("per_leaf_sync", "per_leaf_async", "tree_sync",
+                 "bucketed_async"):
+        assert by_mode.get(mode, 0) > 0, by_mode

@@ -22,13 +22,15 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # ConvNet trained on synthetic data keyed ONLY on (rank, step): any two runs
 # — interrupted or not — see identical batches at identical steps, so loss
 # trajectories and final parameters must agree bit-for-bit.  Grad averaging
-# rides all_reduce_host over the p2p DATA PLANE (TPU_DIST_DP_THRESHOLD=1024
-# pushes the conv/dense kernels onto the chunk-pipelined ring; tiny bias
-# leaves batch through the store) — a real cross-process sync every step;
-# XLA multiprocess computations don't exist on this CPU backend, which is
-# also why the workers block on a dead peer — exactly the hang the
-# resilience layer must break.  The ring's fixed accumulation order keeps
-# the resumed trajectory bit-identical to the clean run.
+# is the BUCKETED ASYNC path (tpu_dist.collectives.Bucketer): every leaf —
+# conv/dense kernels and tiny biases alike — coalesces into flat buckets
+# issued as async ring all-reduces over the p2p data plane, waited at
+# wait_all() — a real cross-process sync every step; XLA multiprocess
+# computations don't exist on this CPU backend, which is also why the
+# workers block on a dead peer — exactly the hang the resilience layer must
+# break.  The ring's fixed accumulation order — preserved bit-for-bit by
+# the bucketer's chunk-major layout — keeps the resumed trajectory
+# bit-identical to the clean run.
 _TRAIN_WORKER = textwrap.dedent("""
     import hashlib, json, os, sys
     os.environ["JAX_PLATFORMS"] = "cpu"
@@ -63,6 +65,7 @@ _TRAIN_WORKER = textwrap.dedent("""
         return jax.value_and_grad(loss)(params)
 
     losses = {}
+    bucketer = C.Bucketer()   # bucketed ASYNC grad sync (25 MiB buckets)
     with resilience.TrainState(ckpt_root, save_every=5, keep=None) as ts:
         state, start = ts.resume({"params": params0,
                                   "opt": opt.init(params0)})
@@ -71,9 +74,11 @@ _TRAIN_WORKER = textwrap.dedent("""
             x, y = batch(step, rank)
             l, g = fwd_bwd(params, x, y)
             g = jax.tree.map(np.asarray, g)
-            g = C.all_reduce_host(g, group=pg, op="avg")
+            work = bucketer.all_reduce(g, op="avg", group=pg)
+            loss_now = float(l)      # overlaps the in-flight grad sync
+            g = work.wait_all(timeout=300)
             params, opt_state = opt.update(g, opt_state, params)
-            losses[step] = float(l)
+            losses[step] = loss_now
             ts.end_step({"params": params, "opt": opt_state}, step)
 
     leaves = [np.asarray(a, np.float32).ravel()

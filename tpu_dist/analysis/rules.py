@@ -26,6 +26,13 @@ deadlocking example per rule):
 - **TD006** — inconsistent lock-acquisition order inside one module (lock
   A taken under B in one place, B under A in another): the ABBA deadlock
   pattern for transport-style modules full of fine-grained locks.
+- **TD007** — async collective ``Work`` handle dropped without ``wait()``:
+  a bare-expression call with ``async_op=True`` (the handle is discarded
+  on the spot), or a handle assigned to a name that is never used again.
+  The collective's *errors* travel on the handle (``PeerGoneError``,
+  ``CollectiveMismatchError`` re-raise at ``wait()``) — dropping it
+  swallows the diagnosis, and gradients synced this way are silently
+  unordered against the consumer.
 
 Heuristics are deliberately name-based (``rank``-ish identifiers,
 ``*_host`` collectives, ``_mu``/``_lock``/``_cv`` locks): this linter
@@ -520,6 +527,91 @@ def rule_td006(tree: ast.AST, path: str) -> List[Finding]:
     return out
 
 
+# -- TD007: dropped async Work handles ----------------------------------------
+
+# calls whose async_op=True form returns a Work future (the eager
+# collectives), plus the bucketer issue call which ALWAYS returns a
+# BucketWork needing wait_all()
+_ASYNC_ISSUERS = COLLECTIVE_CALLS | {"send", "recv"}
+
+
+def _is_async_call(node: ast.AST) -> bool:
+    """A call that returns a Work-like handle: any collective/p2p call with
+    a truthy-constant ``async_op=``, or ``<bucketer>.all_reduce(...)``
+    (always returns a BucketWork)."""
+    if not isinstance(node, ast.Call):
+        return False
+    name = _terminal_name(node.func)
+    if name in _ASYNC_ISSUERS:
+        for kw in node.keywords:
+            if kw.arg == "async_op" and isinstance(kw.value, ast.Constant):
+                return bool(kw.value.value)
+        return False
+    if name == "all_reduce" and isinstance(node.func, ast.Attribute):
+        recv_name = (_dotted(node.func.value) or "").lower()
+        return "bucketer" in recv_name
+    return False
+
+
+def _scopes(tree: ast.AST):
+    """Module + every function definition (a handle's liveness is judged
+    within its enclosing scope, nested functions included — a closure
+    waiting on it counts as a use)."""
+    yield tree
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def rule_td007(tree: ast.AST, path: str) -> List[Finding]:
+    # bare-expression drops are judged globally; assigned-then-unused is
+    # judged per scope (module + each function), where "use" is any
+    # load-context read of the name anywhere under the scope — a closure
+    # or loop waiting on the handle counts.  A statement nested in a
+    # function is seen by both its function's walk and the module walk;
+    # the location-keyed dedupe keeps one finding, and the module walk's
+    # superset of loads can only suppress, never add, assign findings.
+    out: List[Finding] = []
+    seen = set()
+
+    def emit(f: Finding) -> None:
+        key = (f.line, f.col, f.message)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+
+    for scope in _scopes(tree):
+        loads: Dict[str, int] = {}
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                loads[node.id] = loads.get(node.id, 0) + 1
+        for stmt in ast.walk(scope):
+            if not isinstance(stmt, (ast.Expr, ast.Assign)) \
+                    or not _is_async_call(stmt.value):
+                continue
+            call = stmt.value
+            name = _terminal_name(call.func)
+            if isinstance(stmt, ast.Expr):
+                emit(Finding(
+                    "TD007", "error", path, call.lineno, call.col_offset,
+                    f"async collective {name}(..., async_op=True) discards "
+                    f"its Work handle: the result AND any captured error "
+                    f"(PeerGoneError, CollectiveMismatchError) are lost — "
+                    f"keep the handle and wait()/wait_all() it"))
+                continue
+            for t in stmt.targets:
+                if isinstance(t, ast.Name) and loads.get(t.id, 0) == 0:
+                    emit(Finding(
+                        "TD007", "warning", path, call.lineno,
+                        call.col_offset,
+                        f"async collective handle `{t.id}` from "
+                        f"{name}(...) is never used: nothing ever wait()s "
+                        f"on it, so its result and captured errors are "
+                        f"silently dropped"))
+    out.sort(key=lambda f: (f.line, f.col))
+    return out
+
+
 # -- registry -----------------------------------------------------------------
 
 RULES = {
@@ -528,6 +620,7 @@ RULES = {
     "TD004": rule_td004,
     "TD005": rule_td005,
     "TD006": rule_td006,
+    "TD007": rule_td007,
 }
 
 RULE_DOCS = {
@@ -539,6 +632,8 @@ RULE_DOCS = {
     "TD005": "host side effects (store/collectives/time/random) inside "
              "jit-traced functions",
     "TD006": "inconsistent lock-acquisition order within a module",
+    "TD007": "async collective Work handle dropped without wait()/"
+             "wait_all()",
 }
 
 

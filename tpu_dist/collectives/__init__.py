@@ -22,7 +22,12 @@ The eager collectives themselves ride two host transports (see
 docs/collectives.md): the control-plane TCPStore for small payloads, and a
 direct rank↔rank socket **data plane** (:mod:`.transport`) over which large
 array payloads run the same ring algorithm between *processes*
-(:mod:`.ring`: chunk-pipelined ring all-reduce/all-gather, tree broadcast).
+(:mod:`.ring`: double-buffered chunk-pipelined ring all-reduce/all-gather,
+tree broadcast).  All of them take ``async_op=True`` and return a
+:class:`Work` future executed on an ordered engine (:mod:`.work`), and
+:class:`Bucketer` (:mod:`.bucketer`) coalesces gradient trees into flat
+buckets issued as async ring all-reduces — the torch DDP Reducer
+discipline, bit-identical to per-leaf results by construction.
 """
 
 from .ops import (all_gather, all_reduce, all_to_all, broadcast, pmean,
@@ -37,6 +42,11 @@ from .eager import (ReduceOp, all_gather_host, all_gather_object,
 # the in-jit ``ops.ring_all_reduce`` above)
 from . import ring, transport
 from .transport import DataPlane, PeerGoneError
+# async engine: Work futures (async_op=True), the ordered executor, and the
+# gradient bucketer (DDP Reducer / Horovod tensor-fusion parity)
+from . import bucketer, work
+from .work import Work, wait_all
+from .bucketer import Bucketer, BucketWork, bucketed_all_reduce
 
 __all__ = [
     "all_reduce", "all_gather", "reduce_scatter", "broadcast", "all_to_all",
@@ -47,4 +57,6 @@ __all__ = [
     "all_gather_object", "gather_object", "broadcast_object_list",
     "scatter_object_list", "all_to_all_host",
     "ring", "transport", "DataPlane", "PeerGoneError",
+    "work", "Work", "wait_all", "bucketer", "Bucketer", "BucketWork",
+    "bucketed_all_reduce",
 ]

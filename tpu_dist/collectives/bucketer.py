@@ -1,0 +1,364 @@
+"""Gradient bucketing: coalesce pytree leaves into flat buckets and issue
+them as async ring all-reduces (torch DDP Reducer / Horovod tensor-fusion
+parity for the host data plane).
+
+A gradient tree is dozens-to-hundreds of leaves; synchronously ring-reducing
+each one pays the full 2(N-1)-step ring latency per leaf, and the tiny
+leaves never amortize per-frame overhead.  The :class:`Bucketer` packs
+leaves into fixed-size flat buckets (``TPU_DIST_BUCKET_BYTES``, 25 MiB
+default — torch DDP's ``bucket_cap_mb`` default), issues each bucket as ONE
+async ring all-reduce on the ordered engine
+(:mod:`tpu_dist.collectives.work`), and unflattens on ``wait_all()`` — so
+the caller overlaps whatever it computes next with the whole sync, and the
+wire sees a few large pipelined collectives instead of many small ones.
+
+Buckets are filled in **reverse leaf order** (DDP's heuristic: backward
+produces gradients roughly in reverse parameter order, so the last-produced
+gradients — the first ready in a hook-driven flow — sync first).
+
+**Bitwise parity with the per-leaf ring** (the property the chaos e2e's
+bit-identical resume check leans on): a naive bucketer concatenates leaves
+and ring-chunks the concatenation, which moves elements into *different
+ring chunks* than the per-leaf collectives would — a different chunk owner
+means a different (deterministic, but different) float fold order, so
+bucketed sums come out bit-different from the unbucketed path.  This
+bucketer instead lays each bucket out **chunk-major**: bucket chunk *c* is
+the concatenation of every member leaf's own per-leaf ring chunk *c* (each
+leaf split by the same ``_bounds(leaf.size, world)`` the per-leaf ring
+uses), and the ring runs with those custom chunk bounds.  Chunk ownership —
+and therefore the accumulation order of every single element — is identical
+to the per-leaf ring, making bucketed results bit-identical to unbucketed
+ones, per element, including under ``comm_dtype`` wire compression (the
+owner re-quantizes the same chunk either way).
+
+Leaves a ring cannot reduce (unsupported dtype/op, zero-size) fall back to
+ONE coalesced eager ``all_reduce_host`` call issued as a trailing async
+work, so the API contract (every leaf reduced, one ``wait_all``) holds on
+every transport; with no data plane at all the whole tree rides that path.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = ["Bucketer", "BucketWork", "bucketed_all_reduce",
+           "DEFAULT_BUCKET_BYTES"]
+
+DEFAULT_BUCKET_BYTES = 25 * 1024 * 1024  # torch DDP bucket_cap_mb parity
+
+
+def _bucket_bytes_env() -> int:
+    try:
+        return max(4096, int(os.environ.get("TPU_DIST_BUCKET_BYTES",
+                                            str(DEFAULT_BUCKET_BYTES))))
+    except ValueError:
+        return DEFAULT_BUCKET_BYTES
+
+
+def _ring_leaf_ok(a: np.ndarray, op: str) -> bool:
+    """True iff the ring can reduce this leaf (dtype/op only — bucketing
+    exists to aggregate small leaves, so no size threshold).  Must depend
+    only on dtype/op so every rank answers identically."""
+    from . import ring as _ring
+    if op not in _ring.RING_OPS or a.size == 0:
+        return False
+    dt = a.dtype
+    if dt.kind in "iuf":
+        return True
+    if dt.kind == "V" and dt.fields is None:
+        from .transport import _decode_dtype
+        try:
+            return _decode_dtype(dt.name) == dt
+        except Exception:
+            return False
+    return False
+
+
+class _Bucket:
+    """One dtype-uniform bucket: member leaf indices + flat leaf arrays."""
+
+    __slots__ = ("dtype", "indices", "flats", "nbytes")
+
+    def __init__(self, dtype: np.dtype):
+        self.dtype = dtype
+        self.indices: List[int] = []
+        self.flats: List[np.ndarray] = []
+        self.nbytes = 0
+
+    def add(self, idx: int, flat: np.ndarray) -> None:
+        self.indices.append(idx)
+        self.flats.append(flat)
+        self.nbytes += flat.nbytes
+
+    def pack(self, n: int):
+        """Chunk-major layout: returns ``(buf, bucket_bounds, leaf_bounds)``
+        where ``buf`` is the flat bucket, ``bucket_bounds[c]`` the (lo, hi)
+        span of bucket chunk *c*, and ``leaf_bounds[i]`` each member leaf's
+        own per-leaf ring bounds.  Bucket chunk *c* holds every member
+        leaf's chunk *c*, so chunk ownership matches the per-leaf ring
+        exactly (see module docstring)."""
+        from .ring import _bounds
+        leaf_bounds = [_bounds(f.size, n) for f in self.flats]
+        total = sum(f.size for f in self.flats)
+        buf = np.empty(total, dtype=self.dtype)
+        bucket_bounds = []
+        pos = 0
+        for c in range(n):
+            lo = pos
+            for f, b in zip(self.flats, leaf_bounds):
+                flo, fhi = b[c]
+                if fhi > flo:
+                    buf[pos:pos + (fhi - flo)] = f[flo:fhi]
+                    pos += fhi - flo
+            bucket_bounds.append((lo, pos))
+        return buf, bucket_bounds, leaf_bounds
+
+    def unpack(self, reduced: np.ndarray, n: int, leaf_bounds) -> List:
+        """Invert :meth:`pack`: per-member flat reduced arrays (in member
+        order, ``reduced``'s dtype)."""
+        outs = [np.empty(f.size, dtype=reduced.dtype) for f in self.flats]
+        pos = 0
+        for c in range(n):
+            for out, b in zip(outs, leaf_bounds):
+                flo, fhi = b[c]
+                if fhi > flo:
+                    out[flo:fhi] = reduced[pos:pos + (fhi - flo)]
+                    pos += fhi - flo
+        return outs
+
+
+class BucketWork:
+    """Aggregate handle over one bucketed all-reduce: per-bucket
+    :class:`~tpu_dist.collectives.work.Work` futures plus the unflatten.
+    ``wait_all(timeout)`` returns the fully-reduced tree."""
+
+    def __init__(self, treedef, assemble, works: List, label: str):
+        self._treedef = treedef
+        self._assemble = assemble      # (results per work) -> leaves list
+        self.works = list(works)
+        self._label = label
+        self._result = None
+        self._done = False
+
+    def wait_all(self, timeout: Optional[float] = None):
+        """Wait for every bucket; returns the reduced tree.  The first
+        captured error (``PeerGoneError``, ...) re-raises."""
+        if self._done:
+            return self._result
+        from .work import wait_all as _wait_all
+        results = _wait_all(self.works, timeout)
+        import jax
+        leaves = self._assemble(results)
+        self._result = jax.tree.unflatten(self._treedef, leaves)
+        self._done = True
+        return self._result
+
+    # Work-flavored aliases so generic handle code treats the aggregate
+    # like a single collective
+    def wait(self, timeout: Optional[float] = None):
+        return self.wait_all(timeout)
+
+    def is_completed(self) -> bool:
+        return self._done or all(w.is_completed() for w in self.works)
+
+    def exception(self) -> Optional[BaseException]:
+        for w in self.works:
+            exc = w.exception()
+            if exc is not None:
+                return exc
+        return None
+
+    def __repr__(self):
+        state = "done" if self._done else f"{len(self.works)} buckets"
+        return f"BucketWork({self._label!r}, {state})"
+
+
+class Bucketer:
+    """Coalesces pytree leaves into flat buckets and all-reduces them
+    asynchronously; see module docstring.
+
+    Production use (the chaos/elastic grad-sync path)::
+
+        bucketer = C.Bucketer()                  # 25 MiB buckets
+        grads = bucketer.all_reduce(grads, op="avg", group=pg).wait_all()
+
+    ``dp`` pins a specific :class:`DataPlane` (tests drive several
+    in-process "ranks", each with its own plane and its own ordered
+    engine; pinned mode is ring-only).  Production resolves the process's
+    plane lazily inside the work body and shares the process-wide engine
+    with the eager ``async_op`` path, so every async collective in the
+    process rides ONE ordered stream (consistent collective order for the
+    sanitizer and the flight recorder's lockstep sequence).
+    """
+
+    def __init__(self, bucket_bytes: Optional[int] = None, dp=None,
+                 comm_dtype=None):
+        self.bucket_bytes = (int(bucket_bytes) if bucket_bytes
+                             else _bucket_bytes_env())
+        self._dp = dp
+        # wire-compression dtype for pinned (test) mode; production reads
+        # TPU_DIST_COMM_DTYPE like the eager routed collectives
+        self._comm_dtype = comm_dtype
+        # per-instance tag counter for pinned (test) mode: the process-
+        # global eager counters are shared across the in-process "ranks"
+        # and would interleave; allocated at ISSUE time = program order
+        self._seq = 0
+        self._seq_mu = threading.Lock()
+
+    def all_reduce(self, tree, op: str = "avg", group=None) -> BucketWork:
+        """Issue bucketed async all-reduces for every leaf of ``tree``;
+        returns a :class:`BucketWork` (``wait_all()`` -> reduced tree).
+        ``op``: sum/avg/max/min ride the ring; anything else (and
+        ring-incompatible leaves) coalesces onto the store path.
+
+        Leaves are **snapshotted at issue** (the pack copy happens on this
+        thread, before returning), so the caller may mutate its arrays the
+        moment this returns — no torch-style "don't touch until wait"
+        hazard."""
+        import jax
+        from . import eager as _eager
+        from .work import completed_work, engine_for
+
+        op = str(op).lower()
+        _eager._reduce_fn(op)  # validate before anything moves
+        pinned = self._dp is not None
+        if not pinned:
+            group = _eager._default_group(group)
+        n = self._dp.num_processes if pinned else group.num_processes
+        leaves, treedef = jax.tree.flatten(tree)
+        arrs = [np.asarray(l) for l in leaves]
+        label = f"bucket_all_reduce[{op}]x{len(arrs)}"
+
+        if n <= 1:
+            # copy, not views: the snapshot-at-issue contract must hold on
+            # the single-process fast path too (the caller may clobber its
+            # arrays right after issue)
+            out = [np.array(a) for a in arrs]
+            return BucketWork(treedef, lambda results: out,
+                              [completed_work(None, label)], label)
+
+        use_ring = pinned or (_eager._dp_enabled()
+                              and not _eager._prefer_mesh(group)
+                              and _eager._coll_store() is not None)
+        ring_set = {i for i, a in enumerate(arrs)
+                    if use_ring and _ring_leaf_ok(a, op)}
+        rest_idx = [i for i in range(len(arrs)) if i not in ring_set]
+        if pinned and rest_idx:
+            bad = {arrs[i].dtype for i in rest_idx if arrs[i].size}
+            raise ValueError(
+                f"Bucketer(dp=...) is a ring-only harness; leaves with "
+                f"dtypes {sorted(map(str, bad))} (or empty leaves) cannot "
+                f"ride it for op {op!r}")
+
+        # fill dtype-uniform buckets in REVERSE leaf order (DDP heuristic)
+        buckets: List[_Bucket] = []
+        open_by_dtype = {}
+        for i in sorted(ring_set, reverse=True):
+            a = arrs[i]
+            b = open_by_dtype.get(a.dtype)
+            if b is None or b.nbytes + a.nbytes > self.bucket_bytes:
+                b = _Bucket(a.dtype)
+                buckets.append(b)
+                open_by_dtype[a.dtype] = b
+            b.add(i, np.ascontiguousarray(a).reshape(-1))
+
+        engine = engine_for(self._dp)
+        issue_seq = self._next_issue_seq() if pinned else -1
+        works, plans = [], []
+        for bi, bucket in enumerate(buckets):
+            # pack HERE, on the caller's thread: the flat bucket is a
+            # snapshot, so the caller is free to mutate its gradient
+            # arrays the moment all_reduce() returns (packing on the
+            # engine thread would race such mutations and silently
+            # diverge ranks that packed at different times)
+            packed = bucket.pack(n)
+            works.append(engine.submit(
+                self._bucket_body(packed, op, n, group, issue_seq, bi),
+                label=f"{label}/bkt{bi}"))
+            plans.append(("bucket", bucket))
+        if rest_idx:
+            # copy, not views: same issue-time snapshot contract as the
+            # packed buckets — the caller may mutate after issue
+            sub = [np.array(arrs[i]) for i in rest_idx]
+
+            def rest_body(sub=sub, group=group, op=op):
+                # one coalesced eager call: small/exotic leaves batch into
+                # a single store round exactly as a sync tree call would
+                return _eager.all_reduce_host(sub, group=group, op=op)
+
+            works.append(engine.submit(rest_body, label=f"{label}/store"))
+            plans.append(("rest", rest_idx))
+
+        def assemble(results):
+            out: List = [None] * len(arrs)
+            for (kind, plan), res in zip(plans, results):
+                if kind == "bucket":
+                    flats = plan.unpack(res[0], n, res[1])
+                    for idx, flat in zip(plan.indices, flats):
+                        out[idx] = flat.reshape(arrs[idx].shape)
+                else:
+                    for idx, val in zip(plan, res):
+                        out[idx] = np.asarray(val)
+            return out
+
+        return BucketWork(treedef, assemble, works, label)
+
+    # -- internals -----------------------------------------------------------
+
+    def _next_issue_seq(self) -> int:
+        with self._seq_mu:
+            s = self._seq
+            self._seq += 1
+            return s
+
+    def _bucket_body(self, packed, op: str, n: int, group,
+                     issue_seq: int, bi: int):
+        """The deferred per-bucket collective: ring all-reduce the
+        (already-packed, issue-time-snapshotted) flat bucket with its
+        per-leaf-aligned bounds, return ``(reduced_flat, leaf_bounds)``.
+        Runs on the ordered engine."""
+        buf, bucket_bounds, leaf_bounds = packed
+
+        def body():
+            from . import eager as _eager
+            from . import ring as _ring
+            if self._dp is not None:
+                dp = self._dp
+                tag = f"bkt/i{issue_seq}/{bi}"
+                comm = self._comm_dtype
+            else:
+                store = _eager._coll_store()
+                # sequence allocated HERE, in engine order — every rank
+                # submits the same buckets in the same order, so the k-th
+                # body draws the k-th seq on every rank
+                seq = _eager._next_seq("bucket_ar", 0)
+                tag = f"{_eager._ns()}/coll/bkt/{seq}"
+                _eager._sanitize("bucket_all_reduce", group, store,
+                                 value=buf, reduce_op=op)
+                dp = _eager._maybe_data_plane(group, store)
+                comm = _eager._comm_dtype()
+            with _eager._obs_span("bucket_all_reduce", value=buf,
+                                  reduce_op=op):
+                t0 = time.perf_counter()
+                reduced = _ring.ring_all_reduce(dp, buf, op=op, tag=tag,
+                                                comm_dtype=comm,
+                                                bounds=bucket_bounds)
+                _eager._record("bucket_all_reduce", "dataplane",
+                               buf.nbytes, t0)
+            return reduced, leaf_bounds
+
+        return body
+
+
+def bucketed_all_reduce(tree, op: str = "avg", group=None,
+                        bucket_bytes: Optional[int] = None):
+    """Synchronous convenience: bucketed all-reduce, waited inline (still
+    coalesced + pipelined on the wire; the async win needs ``Bucketer``
+    plus caller-side overlap)."""
+    return Bucketer(bucket_bytes=bucket_bytes).all_reduce(
+        tree, op=op, group=group).wait_all()

@@ -109,6 +109,41 @@ def _default_group(group):
     return group
 
 
+# -- async engine glue (tpu_dist/collectives/work.py) -------------------------
+#
+# async_op=True submits the collective body to the process-wide ordered
+# engine and returns a Work future; every SYNC multi-rank entry point drains
+# the engine first so sync ops cannot overtake queued async ones (stream
+# semantics — ranks must agree on collective order for the ring tags, the
+# sanitizer's signature sequence, and the flight recorder's lockstep seq).
+
+
+def _submit_async(body, label: str, group, fast_path):
+    """Submit ``body`` as an async collective; single-process groups get an
+    already-completed Work carrying ``fast_path()`` (same contract, no
+    thread hop)."""
+    from .work import completed_work, engine_for
+    if group.num_processes <= 1:
+        return completed_work(fast_path(), label)
+    return engine_for(None).submit(body, label=label)
+
+
+def _snapshot(x):
+    """Issue-time copy of an async collective's input tree.  The body runs
+    later on the engine thread; without a snapshot its ``np.asarray``
+    reads would race caller mutations (e.g. accumulating the next
+    microbatch into the same gradient buffers), silently and
+    non-deterministically.  With it, the caller may mutate its arrays the
+    moment the Work handle returns — the same contract as the bucketer's
+    pack-at-issue (tpu_dist/collectives/bucketer.py)."""
+    return jax.tree.map(np.array, x)
+
+
+def _drain_async() -> None:
+    from .work import drain_pending
+    drain_pending()
+
+
 # the armed values sanitizer.enabled() recognizes — the gate here must
 # parse identically or TPU_DIST_SANITIZE=0 would arm the check one-sidedly
 # (ranks disagreeing on armed-ness deadline-fail every healthy collective)
@@ -135,18 +170,35 @@ def _sanitize(op: str, group, store=None, **fields) -> None:
     sanitizer.check_collective(group, store, op, **fields)
 
 
-def all_reduce_host(x, group=None, op: str = ReduceOp.SUM):
+def all_reduce_host(x, group=None, op: str = ReduceOp.SUM,
+                    async_op: bool = False):
     """Reduce a per-process host value across processes; returns the reduced
     value on host (as numpy / python scalar tree).
 
     Transport: leaves of at least ``TPU_DIST_DP_THRESHOLD`` bytes with a
     ring-supported op (sum/avg/max/min) ride the p2p data plane as a
     chunk-pipelined ring all-reduce; everything else batches into one store
-    round.  Without a store: mesh collectives, as before."""
+    round.  Without a store: mesh collectives, as before.
+
+    ``async_op=True`` returns a :class:`~tpu_dist.collectives.work.Work`
+    future executed on the process's ordered engine — ``wait()`` yields the
+    reduced tree and re-raises any error (``PeerGoneError``, ...) the
+    collective hit in flight.  The input tree is snapshotted at issue, so
+    the caller may mutate its arrays immediately."""
     group = _default_group(group)
     fn = _reduce_fn(op)  # validate op before the fast path returns
+    if async_op:
+        x = _snapshot(x)
+        return _submit_async(lambda: _all_reduce_body(x, group, op, fn),
+                             f"all_reduce[{str(op).lower()}]", group,
+                             lambda: x)
     if group.num_processes <= 1:
         return jax.tree.map(np.asarray, x)
+    _drain_async()
+    return _all_reduce_body(x, group, op, fn)
+
+
+def _all_reduce_body(x, group, op, fn):
     with _obs_span("all_reduce", value=x, reduce_op=op):
         store = _coll_store()
         _sanitize("all_reduce", group, store, value=x, reduce_op=op)
@@ -185,15 +237,26 @@ def _routed_all_reduce(x, group, store, op, fn):
     return jax.tree.unflatten(treedef, out)
 
 
-def all_gather_host(x, group=None):
+def all_gather_host(x, group=None, async_op: bool = False):
     """Gather per-process values; returns tree with leading process axis.
 
     Transport: large leaves ride the p2p data plane as a ring all-gather,
     small ones batch through one store round; mesh collectives without a
-    store."""
+    store.  ``async_op=True`` returns a Work future, input snapshotted at
+    issue (see :func:`all_reduce_host`)."""
     group = _default_group(group)
+    if async_op:
+        x = _snapshot(x)
+        return _submit_async(lambda: _all_gather_body(x, group),
+                             "all_gather", group,
+                             lambda: jax.tree.map(lambda v: v[None], x))
     if group.num_processes <= 1:
         return jax.tree.map(lambda v: np.asarray(v)[None], x)
+    _drain_async()
+    return _all_gather_body(x, group)
+
+
+def _all_gather_body(x, group):
     with _obs_span("all_gather", value=x):
         store = _coll_store()
         _sanitize("all_gather", group, store, value=x)
@@ -227,7 +290,7 @@ def _routed_all_gather(x, group, store):
     return jax.tree.unflatten(treedef, out)
 
 
-def broadcast_host(x, group=None, src: int = 0):
+def broadcast_host(x, group=None, src: int = 0, async_op: bool = False):
     """Broadcast process ``src``'s value to all processes (DDP's wrap-time
     rank-0 parameter broadcast, /root/reference/example_mp.py:53).
 
@@ -235,10 +298,22 @@ def broadcast_host(x, group=None, src: int = 0):
     broadcast (log2(N) point-to-point rounds), small ones as one pickled
     store key; mesh collectives without a store.  As with the mesh path,
     every rank passes an ``x`` of the broadcast structure (non-src leaves
-    are shape/dtype templates)."""
+    are shape/dtype templates).  ``async_op=True`` returns a Work future,
+    input snapshotted at issue (see :func:`all_reduce_host`)."""
     group = _default_group(group)
+    if async_op:
+        if group.num_processes > 1:
+            _check_peer(src, group, "src")  # caller bugs raise at issue
+        x = _snapshot(x)
+        return _submit_async(lambda: _broadcast_body(x, group, src),
+                             "broadcast", group, lambda: x)
     if group.num_processes <= 1:
         return jax.tree.map(np.asarray, x)
+    _drain_async()
+    return _broadcast_body(x, group, src)
+
+
+def _broadcast_body(x, group, src):
     with _obs_span("broadcast", value=x, src=src):
         store = _coll_store()
         _sanitize("broadcast", group, store, value=x, src=src)
@@ -301,6 +376,7 @@ def reduce_host(x, dst: int = 0, group=None, op: str = ReduceOp.SUM):
     _check_peer(dst, group, "dst")
     if group.num_processes <= 1:
         return jax.tree.map(np.asarray, x)
+    _drain_async()
     with _obs_span("reduce", value=x, reduce_op=op, dst=dst):
         store = _coll_store()
         _sanitize("reduce", group, store, value=x, reduce_op=op, dst=dst)
@@ -558,6 +634,7 @@ def gather_host(x, dst: int = 0, group=None) -> Optional[List]:
     n = group.num_processes
     if n <= 1:
         return [jax.tree.map(np.asarray, x)]
+    _drain_async()
     with _obs_span("gather", value=x, dst=dst):
         return _gather_host(x, dst, group, n)
 
@@ -632,6 +709,7 @@ def scatter_host(output_template, scatter_list: Optional[List] = None,
             return payload[0]
     else:
         payload = None
+    _drain_async()
     with _obs_span("scatter", value=output_template, src=src):
         return _scatter_host(output_template, payload, src, group, n)
 
@@ -757,6 +835,7 @@ def scatter_object_list(scatter_object_input_list: Optional[List[Any]] = None,
                 f"num_processes={n} entries, got {got}")
         if n <= 1:
             return scatter_object_input_list[0]
+    _drain_async()
     store = _coll_store()
     if store is not None:
         # O(1)-per-rank: one store key per destination (see gather_host)
@@ -795,6 +874,7 @@ def all_to_all_host(input_list: List[Any], group=None) -> List[Any]:
                          f"(num_processes={n}), got {len(input_list)}")
     if n <= 1:
         return list(input_list)
+    _drain_async()
     with _obs_span("all_to_all", value=input_list):
         return _all_to_all_host(input_list, group, n)
 
@@ -862,7 +942,7 @@ def _p2p_wire_tag(tag: int, seq: int) -> str:
     return f"p2p/t{tag}/{seq}"
 
 
-def send(x, dst: int, group=None, tag: int = 0) -> None:
+def send(x, dst: int, group=None, tag: int = 0, async_op: bool = False):
     """torch ``dist.send`` parity: deliver this process's array to process
     ``dst``.  Matched by program order per (src, dst, tag), like torch.
 
@@ -872,7 +952,11 @@ def send(x, dst: int, group=None, tag: int = 0) -> None:
     block on the receiver either way.  The receiver matches either
     transport by the shared (src, dst, tag, seq) discipline.  For tensor
     p2p between devices of the SAME mesh use :func:`send_recv_device`
-    (one ppermute hop over ICI, never touches the host)."""
+    (one ppermute hop over ICI, never touches the host).
+
+    ``async_op=True`` (torch ``dist.isend`` flavor) returns a Work future;
+    a dead peer surfaces as ``PeerGoneError`` at ``wait()``.  The payload
+    is snapshotted at issue — mutate it freely afterwards."""
     group = _default_group(group)
     me = group.rank
     if dst == me:
@@ -880,6 +964,18 @@ def send(x, dst: int, group=None, tag: int = 0) -> None:
     if not 0 <= dst < group.num_processes:
         raise ValueError(f"dst {dst} out of range "
                          f"(num_processes={group.num_processes})")
+    if async_op:
+        from .work import engine_for
+        arr = np.array(x)
+        return engine_for(None).submit(
+            lambda: _send_body(arr, dst, group, tag),
+            label=f"send->r{dst}")
+    _drain_async()
+    return _send_body(x, dst, group, tag)
+
+
+def _send_body(x, dst: int, group, tag: int) -> None:
+    me = group.rank
     store = _p2p_store()
     # the sequence number is consumed only on a successful handoff: a send
     # that raises (dead peer, store trouble) leaves the counter untouched,
@@ -952,17 +1048,21 @@ def send_recv_device(x, src: int, dst: int, group=None):
     return fn(x)
 
 
-def recv(src: int, group=None, tag: int = 0) -> np.ndarray:
+def recv(src: int, group=None, tag: int = 0, async_op: bool = False):
     """torch ``dist.recv`` parity: block until the matching :func:`send`
     from ``src`` arrives; returns the array (no preallocated output buffer
     needed — shape/dtype travel on the wire).
 
     The sender picks the transport by payload size, which the receiver
-    cannot know in advance — so with a data plane up, recv polls both the
-    p2p frame queue and the store key for the matching (src, tag, seq)
-    until one delivers.  A sender that dies with the message owed surfaces
-    as :class:`~tpu_dist.collectives.transport.PeerGoneError` instead of a
-    hang."""
+    cannot know in advance — so with a data plane up, recv watches both the
+    p2p frame queue (condition-variable wakeup, instant on frame arrival)
+    and the store key for the matching (src, tag, seq) until one delivers.
+    A sender that dies with the message owed surfaces as
+    :class:`~tpu_dist.collectives.transport.PeerGoneError` instead of a
+    hang.
+
+    ``async_op=True`` (torch ``dist.irecv`` flavor) returns a Work future
+    whose ``wait()`` yields the array."""
     group = _default_group(group)
     me = group.rank
     if src == me:
@@ -970,6 +1070,15 @@ def recv(src: int, group=None, tag: int = 0) -> np.ndarray:
     if not 0 <= src < group.num_processes:
         raise ValueError(f"src {src} out of range "
                          f"(num_processes={group.num_processes})")
+    if async_op:
+        from .work import engine_for
+        return engine_for(None).submit(lambda: _recv_outer(src, group, tag),
+                                       label=f"recv<-r{src}")
+    _drain_async()
+    return _recv_outer(src, group, tag)
+
+
+def _recv_outer(src: int, group, tag: int) -> np.ndarray:
     with _obs_span("recv", src=src, kind="p2p"):
         return _recv(src, group, tag)
 
@@ -999,35 +1108,21 @@ def _recv(src: int, group, tag: int) -> np.ndarray:
           if _dp_enabled() and not _prefer_mesh(group) else None)
     if dp is None:
         return _from_store()  # blocking get until the key exists
-    from .transport import _default_timeout
     wire_tag = _p2p_wire_tag(tag, seq)
-    delay = 0.0002
-    timeout = _default_timeout()
-    deadline = (time.monotonic() + timeout) if timeout > 0 else None
-    while True:
-        arr = dp.try_recv_array(src, wire_tag)
-        if arr is not None:
-            return _delivered(arr, "dataplane")
-        if store.check(key):
-            return _from_store()
-        gone = dp.peer_gone(src)
-        if gone is not None:
-            # the peer died — re-check both sources once (a frame/key that
-            # landed between our poll and the death report still counts),
-            # then diagnose: the message can never arrive
-            arr = dp.try_recv_array(src, wire_tag)
-            if arr is not None:
-                return _delivered(arr, "dataplane")
-            if store.check(key):
-                continue
-            raise dp.gone_error(src, gone)
-        if deadline is not None and time.monotonic() > deadline:
-            # a sender that died before ever connecting leaves no inbound
-            # socket to diagnose — the deadline converts that into a named
-            # timeout instead of an unbounded dual-transport poll
-            raise TimeoutError(
-                f"recv from rank {src} tag {tag} seq {seq} got neither a "
-                f"data-plane frame nor a store key within "
-                f"{timeout:.0f}s (TPU_DIST_DP_TIMEOUT)")
-        time.sleep(delay)
-        delay = min(delay * 2, 0.02)  # back off: don't hammer the server
+    # condition-variable wakeup on the data-plane side (a frame or a peer
+    # death wakes this instantly), bounded-backoff polling of the store key
+    # between CV waits — replaces the old dual-transport busy-poll loop
+    try:
+        path, arr = dp.recv_array_dual(src, wire_tag,
+                                       alt_check=lambda: store.check(key))
+    except TimeoutError as e:
+        # a sender that died before ever connecting leaves no inbound
+        # socket to diagnose — the deadline converts that into a named
+        # timeout instead of an unbounded dual-transport wait
+        raise TimeoutError(
+            f"recv from rank {src} tag {tag} seq {seq} got neither a "
+            f"data-plane frame nor a store key before the "
+            f"TPU_DIST_DP_TIMEOUT deadline: {e}") from e
+    if path == "dataplane":
+        return _delivered(arr, "dataplane")
+    return _from_store()

@@ -16,6 +16,21 @@ sub-chunk, so wire transfer and the local ``np.add``/``maximum``/``minimum``
 overlap — the same overlap argument the paper makes for ring steps, applied
 inside each step.
 
+Double-buffered steps: within every ring step the send of sub-chunk *j+1*
+and the fold of whatever already arrived interleave in one loop
+(:func:`_exchange`) — the step used to serialize "send the whole chunk,
+then fold the whole arriving chunk", which left the CPU idle during the
+send syscalls and the wire idle during the folds.  The arriving frames land
+in the transport's preallocated per-frame buffers (the recv for step *k+1*
+is effectively always posted: the reader thread never stops draining), so
+the only blocking recv is for frames that genuinely have not arrived yet.
+
+Custom chunk ``bounds``: :func:`ring_all_reduce` accepts an explicit chunk
+partition so the gradient bucketer can align bucket chunks with each member
+leaf's own per-leaf chunks — identical chunk ownership means identical
+accumulation order, which is what makes bucketed results bit-identical to
+per-leaf ones (tpu_dist/collectives/bucketer.py).
+
 ``comm_dtype`` (EQuARX-style wire compression, arXiv:2506.17615): payloads
 are cast to a narrower dtype on the wire and re-widened for accumulation.
 After the reduce-scatter the owning rank re-quantizes its fully-reduced
@@ -132,27 +147,65 @@ def _send_span(dp, dst: int, tag: str, flat: np.ndarray, lo: int, hi: int,
         dp.send_array(dst, tag, seg)
 
 
+def _fold(flat: np.ndarray, seg: np.ndarray, pos: int, hi: int, tag: str,
+          combine) -> int:
+    """Fold one arriving frame into ``flat[pos:pos+len]``; returns the new
+    position.  ``combine`` is a ufunc (reduce-scatter) or None (overwrite,
+    all-gather); frames in a narrower wire dtype widen here."""
+    m = seg.size
+    if pos + m > hi:
+        raise RuntimeError(
+            f"ring frame overrun: got {m} elements at {pos} with only "
+            f"{hi - pos} expected (tag {tag!r})")
+    part = seg if seg.dtype == flat.dtype else seg.astype(flat.dtype)
+    if combine is None:
+        flat[pos:pos + m] = part
+    else:
+        combine(flat[pos:pos + m], part, out=flat[pos:pos + m])
+    return pos + m
+
+
 def _recv_span(dp, src: int, tag: str, flat: np.ndarray, lo: int, hi: int,
                combine=None) -> None:
-    """Receive sub-chunk frames into flat[lo:hi]; ``combine`` is a ufunc to
-    fold frames into the existing values (reduce-scatter), None to
-    overwrite (all-gather).  Each arriving frame is processed while the
-    transport thread keeps reading the next one off the wire."""
+    """Receive sub-chunk frames into flat[lo:hi]; each arriving frame is
+    processed while the transport thread keeps reading the next one off
+    the wire."""
     pos = lo
     while pos < hi:
         # tpudlint: disable=TD004  # recv_array applies TPU_DIST_DP_TIMEOUT
-        seg = dp.recv_array(src, tag)
-        m = seg.size
-        if pos + m > hi:
-            raise RuntimeError(
-                f"ring frame overrun: got {m} elements at {pos} with only "
-                f"{hi - pos} expected (tag {tag!r})")
-        part = seg if seg.dtype == flat.dtype else seg.astype(flat.dtype)
-        if combine is None:
-            flat[pos:pos + m] = part
-        else:
-            combine(flat[pos:pos + m], part, out=flat[pos:pos + m])
-        pos += m
+        pos = _fold(flat, dp.recv_array(src, tag), pos, hi, tag, combine)
+
+
+def _exchange(dp, right: int, left: int, tag: str, flat: np.ndarray,
+              send_lo: int, send_hi: int, recv_lo: int, recv_hi: int,
+              combine, wire_dtype: Optional[np.dtype]) -> None:
+    """One double-buffered ring step: send ``flat[send_lo:send_hi]`` to
+    ``right`` as sub-chunk frames while folding the frames arriving from
+    ``left`` into ``flat[recv_lo:recv_hi]``.
+
+    The send of sub-chunk *j+1* overlaps the fold of sub-chunk *i*: after
+    every send the loop drains (non-blocking) whatever the transport's
+    reader thread already queued, so CPU reduce time hides behind the wire
+    and vice versa.  Only frames that genuinely have not arrived when the
+    sends are done cost a blocking wait."""
+    step = max(1, _chunk_bytes() // flat.itemsize)
+    sp, rp = send_lo, recv_lo
+    while sp < send_hi:
+        nxt = min(sp + step, send_hi)
+        seg = flat[sp:nxt]
+        sp = nxt
+        if wire_dtype is not None and seg.dtype != wire_dtype:
+            seg = seg.astype(wire_dtype)
+        dp.send_array(right, tag, seg)
+        while rp < recv_hi:
+            got = dp.try_recv_array(left, tag)
+            if got is None:
+                break
+            rp = _fold(flat, got, rp, recv_hi, tag, combine)
+    while rp < recv_hi:
+        # tpudlint: disable=TD004  # recv_array applies TPU_DIST_DP_TIMEOUT
+        rp = _fold(flat, dp.recv_array(left, tag), rp, recv_hi, tag,
+                   combine)
 
 
 def _obs_span(op: str, value):
@@ -175,34 +228,34 @@ def _prepare(dp, x, op: str):
 
 def _reduce_scatter_phase(dp, flat, bounds, n, r, op, tag,
                           wire_dtype) -> None:
-    """N-1 ring steps; afterwards this rank's own chunk ``bounds[r]`` holds
-    the full reduction.  Schedule is the textbook one shifted so rank r
-    ends up owning chunk r (send chunk (r-1-step), absorb (r-2-step))."""
+    """N-1 double-buffered ring steps; afterwards this rank's own chunk
+    ``bounds[r]`` holds the full reduction.  Schedule is the textbook one
+    shifted so rank r ends up owning chunk r (send chunk (r-1-step),
+    absorb (r-2-step)); within each step send and fold interleave
+    (:func:`_exchange`)."""
     comb = _combine(op)
     right, left = (r + 1) % n, (r - 1) % n
     rp = (r - 1) % n
     for step in range(n - 1):
         si = (rp - step) % n
         ri = (rp - step - 1) % n
-        _send_span(dp, right, tag, flat, *bounds[si], wire_dtype=wire_dtype)
-        # frames arriving in a narrower wire dtype are widened to the
-        # accumulator dtype inside _recv_span before folding in
-        _recv_span(dp, left, tag, flat, *bounds[ri], combine=comb)
+        _exchange(dp, right, left, tag, flat, *bounds[si], *bounds[ri],
+                  combine=comb, wire_dtype=wire_dtype)
 
 
 def _all_gather_phase(dp, flat, bounds, n, r, tag, wire_dtype) -> None:
-    """N-1 ring steps circulating the fully-reduced chunks (rank r starts
-    owning chunk r)."""
+    """N-1 double-buffered ring steps circulating the fully-reduced chunks
+    (rank r starts owning chunk r)."""
     right, left = (r + 1) % n, (r - 1) % n
     for step in range(n - 1):
         si = (r - step) % n
         ri = (r - step - 1) % n
-        _send_span(dp, right, tag, flat, *bounds[si], wire_dtype=wire_dtype)
-        _recv_span(dp, left, tag, flat, *bounds[ri], combine=None)
+        _exchange(dp, right, left, tag, flat, *bounds[si], *bounds[ri],
+                  combine=None, wire_dtype=wire_dtype)
 
 
 def ring_all_reduce(dp, x, op: str = "sum", tag: str = "ar",
-                    comm_dtype=None) -> np.ndarray:
+                    comm_dtype=None, bounds=None) -> np.ndarray:
     """Bandwidth-optimal ring all-reduce of ``x`` across the group.
 
     reduce-scatter + all-gather, 2(N-1)/N of the payload on the wire per
@@ -211,6 +264,11 @@ def ring_all_reduce(dp, x, op: str = "sum", tag: str = "ar",
     averaged bytes).  Deterministic accumulation order (ring order from
     each chunk's owner), so repeated runs are bit-identical — the property
     the chaos e2e's resume check depends on.
+
+    ``bounds`` overrides the chunk partition (N contiguous ``(lo, hi)``
+    spans covering the flat payload, identical on every rank): the
+    bucketer aligns bucket chunks with per-leaf chunks this way so that
+    bucketed and per-leaf reductions share fold order bit-for-bit.
     """
     x, op, n, r, flat = _prepare(dp, x, op)
     _combine(op)  # raise on an unsupported op before any traffic
@@ -220,7 +278,17 @@ def ring_all_reduce(dp, x, op: str = "sum", tag: str = "ar",
     wire = np.dtype(comm_dtype) if comm_dtype is not None else None
     if flat.size == 0:
         return flat.astype(out_dtype).reshape(x.shape)
-    bounds = _bounds(flat.size, n)
+    if bounds is None:
+        bounds = _bounds(flat.size, n)
+    else:
+        bounds = [(int(lo), int(hi)) for lo, hi in bounds]
+        if (len(bounds) != n or bounds[0][0] != 0
+                or bounds[-1][1] != flat.size
+                or any(bounds[i][1] != bounds[i + 1][0]
+                       for i in range(n - 1))):
+            raise ValueError(
+                f"bounds must be {n} contiguous spans covering "
+                f"[0, {flat.size}), got {bounds}")
     utag = f"{tag}/rar"
     with _obs_span("ring_all_reduce", x):
         _reduce_scatter_phase(dp, flat, bounds, n, r, op, utag, wire)
@@ -271,13 +339,18 @@ def ring_all_gather(dp, x, tag: str = "ag") -> np.ndarray:
     out[r] = flat
     right, left = (r + 1) % n, (r - 1) % n
     utag = f"{tag}/rag"
+    # the (n, size) block matrix viewed flat so each step's send/recv rows
+    # become spans of ONE buffer the double-buffered exchange can walk
+    out_flat = out.reshape(-1)
+    sz = flat.size
     with _obs_span("ring_all_gather", x):
         for step in range(n - 1):
             si = (r - step) % n
             ri = (r - step - 1) % n
-            _send_span(dp, right, utag, out[si], 0, flat.size,
-                       wire_dtype=None)
-            _recv_span(dp, left, utag, out[ri], 0, flat.size, combine=None)
+            if sz:
+                _exchange(dp, right, left, utag, out_flat,
+                          si * sz, (si + 1) * sz, ri * sz, (ri + 1) * sz,
+                          combine=None, wire_dtype=None)
     return out.reshape((n,) + x.shape)
 
 

@@ -33,7 +33,13 @@ Design notes:
 
 Env knobs: ``TPU_DIST_DP_HOST`` (advertised address override),
 ``TPU_DIST_DP_TIMEOUT`` (recv deadline, seconds, default 300),
-``TPU_DIST_NO_DATAPLANE=1`` (disable; collectives fall back to the store).
+``TPU_DIST_NO_DATAPLANE=1`` (disable; collectives fall back to the store),
+``TPU_DIST_SOCK_BUF`` (bytes for ``SO_SNDBUF``/``SO_RCVBUF`` on every
+data-plane socket; 0/unset keeps the OS default — the negotiated sizes are
+recorded on the peer-connect flight-recorder event).  All sockets run with
+``TCP_NODELAY``: ring sub-chunk frames are latency-sensitive and must not
+sit in Nagle's buffer, and header+payload leave in ONE vectored ``sendmsg``
+call anyway, so there is no small-segment flood for Nagle to fix.
 """
 
 from __future__ import annotations
@@ -79,6 +85,56 @@ def _default_timeout() -> float:
         return float(os.environ.get("TPU_DIST_DP_TIMEOUT", "300"))
     except ValueError:
         return 300.0
+
+
+def _sock_buf_bytes() -> int:
+    """Requested ``SO_SNDBUF``/``SO_RCVBUF`` size (``TPU_DIST_SOCK_BUF``;
+    0 = keep the OS default).  Bigger buffers keep a whole ring sub-chunk
+    in flight per direction on high-BDP links."""
+    try:
+        return max(0, int(os.environ.get("TPU_DIST_SOCK_BUF", "0")))
+    except ValueError:
+        return 0
+
+
+def _tune_socket(sock) -> Tuple[int, int]:
+    """Apply TCP_NODELAY + requested buffer sizes; returns the negotiated
+    ``(sndbuf, rcvbuf)`` the kernel actually granted (it may clamp or, on
+    Linux, double the request)."""
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    want = _sock_buf_bytes()
+    if want:
+        try:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, want)
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, want)
+        except OSError:
+            pass  # a clamped/refused request is diagnostic, not fatal
+    try:
+        return (sock.getsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF),
+                sock.getsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF))
+    except OSError:
+        return (0, 0)
+
+
+def _sendv(sock, header: bytes, payload) -> None:
+    """Vectored send: header + payload leave in one ``sendmsg`` syscall —
+    no concat copy of the payload, no separate header segment on the wire.
+    Falls back to two ``sendall`` calls where ``sendmsg`` is missing."""
+    if not len(payload):
+        sock.sendall(header)
+        return
+    if not hasattr(sock, "sendmsg"):
+        sock.sendall(header)
+        sock.sendall(payload)
+        return
+    hlen, plen = len(header), len(payload)
+    total = hlen + plen
+    sent = sock.sendmsg([header, payload])
+    while sent < total:  # partial vectored send: resume across both parts
+        if sent < hlen:
+            sent += sock.sendmsg([memoryview(header)[sent:], payload])
+        else:
+            sent += sock.send(payload[sent - hlen:])
 
 
 def _recv_exact(conn, n: int) -> Optional[bytearray]:
@@ -194,11 +250,12 @@ class DataPlane:
                 conn, _ = self._listener.accept()
             except OSError:
                 return
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            threading.Thread(target=self._reader, args=(conn,), daemon=True,
+            bufs = _tune_socket(conn)
+            threading.Thread(target=self._reader, args=(conn, bufs),
+                             daemon=True,
                              name=f"tpu_dist-dp-reader-r{self.rank}").start()
 
-    def _reader(self, conn):
+    def _reader(self, conn, bufs=(0, 0)):
         peer = None
         detail = "connection closed"
         try:
@@ -220,7 +277,7 @@ class DataPlane:
                 # wait for its frames again instead of failing spuriously
                 self._dead.pop(peer, None)
                 self._in_conn[peer] = conn
-            self._obs("peer-connect", peer)
+            self._obs("peer-connect", peer, sndbuf=bufs[0], rcvbuf=bufs[1])
             while True:
                 frame = self._read_frame(conn)
                 if frame is None:
@@ -298,7 +355,7 @@ class DataPlane:
         host, _, port = raw.decode().rpartition(":")
         sock = socket.create_connection((host, int(port)),
                                         timeout=_CONNECT_TIMEOUT)
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        _tune_socket(sock)
         sock.settimeout(None)
         sock.sendall(_HELLO.pack(_MAGIC, self.rank, self.generation))
         return sock
@@ -327,9 +384,7 @@ class DataPlane:
                 if sock is None:
                     sock = self._connect(dst)
                     self._out[dst] = sock
-                sock.sendall(header)
-                if len(payload):
-                    sock.sendall(payload)
+                _sendv(sock, header, payload)
             except PeerGoneError as e:
                 send_err = e  # _connect diagnosed the peer; the obs-tail
                 # enrichment still happens below, outside the lock
@@ -406,31 +461,73 @@ class DataPlane:
         direction), so repeated calls with the same tag see the sender's
         chunk sequence in order.  Raises :class:`PeerGoneError` when the
         peer's connection died with frames still owed, ``TimeoutError``
-        after ``timeout`` seconds (default ``TPU_DIST_DP_TIMEOUT``, 300)."""
+        after ``timeout`` seconds (default ``TPU_DIST_DP_TIMEOUT``, 300).
+
+        One wait loop exists — this delegates to :meth:`recv_array_dual`
+        (with no alternate transport), so the peer-death / close / deadline
+        handling cannot drift between the single- and dual-transport
+        paths."""
+        _, arr = self.recv_array_dual(src, tag, alt_check=None,
+                                      timeout=timeout)
+        return arr
+
+    def recv_array_dual(self, src: int, tag: str, alt_check=None,
+                        timeout: Optional[float] = None):
+        """Wait for a frame from ``(src, tag)`` OR for ``alt_check()`` (a
+        cheap poll of a second transport, e.g. a store key) to turn true.
+
+        Returns ``("dataplane", arr)`` or ``("alt", None)``.  Frame
+        arrival and peer death wake this *immediately* through the
+        transport's condition variable; the alternate transport is polled
+        between CV waits at an exponentially-backed-off interval (bounded
+        at 50 ms), never while holding the CV — a store round-trip under
+        the lock would stall every reader thread's frame delivery.  This
+        replaces the old busy-poll loop in ``eager.recv`` (0.2 ms sleeps
+        hammering both transports).  Raises :class:`PeerGoneError` /
+        ``TimeoutError`` like :meth:`recv_array`."""
         if timeout is None:
             timeout = _default_timeout()
         deadline = (time.monotonic() + timeout) if timeout > 0 else None
-        with self._cv:
-            while True:
-                arr = self._pop_locked(src, tag)
+        # with no alternate transport there is nothing to poll between CV
+        # waits — park in long slices instead of the alt-poll backoff
+        poll, poll_cap = (0.002, 0.05) if alt_check is not None \
+            else (1.0, 1.0)
+        while True:
+            dead_detail = None
+            with self._cv:
+                slice_end = time.monotonic() + poll
+                while True:
+                    arr = self._pop_locked(src, tag)
+                    if arr is not None:
+                        return "dataplane", arr
+                    if src in self._dead:
+                        dead_detail = self._dead[src]
+                        break
+                    if self._closing:
+                        raise RuntimeError("data plane closed during recv")
+                    now = time.monotonic()
+                    wake = slice_end if deadline is None \
+                        else min(slice_end, deadline)
+                    if wake - now <= 0:
+                        break
+                    self._cv.wait(wake - now)
+            # outside the CV: consult the alternate transport / diagnose
+            if alt_check is not None and alt_check():
+                return "alt", None
+            if dead_detail is not None:
+                # the peer died — one last look at both sources (a frame
+                # or key that landed between our check and the death
+                # report still counts), then a named diagnosis
+                with self._cv:
+                    arr = self._pop_locked(src, tag)
                 if arr is not None:
-                    return arr
-                if src in self._dead:
-                    dead_detail = self._dead[src]
-                    break  # raise outside the lock: the obs-tail lookup
-                    # in gone_error is a store round-trip
-                if self._closing:
-                    raise RuntimeError("data plane closed during recv")
-                if deadline is None:
-                    self._cv.wait(1.0)
-                else:
-                    left = deadline - time.monotonic()
-                    if left <= 0:
-                        raise TimeoutError(
-                            f"data-plane recv from rank {src} tag {tag!r} "
-                            f"timed out after {timeout:.0f}s")
-                    self._cv.wait(min(left, 1.0))
-        raise self.gone_error(src, dead_detail)
+                    return "dataplane", arr
+                raise self.gone_error(src, dead_detail)
+            if deadline is not None and time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"data-plane recv from rank {src} tag {tag!r} "
+                    f"timed out after {timeout:.0f}s")
+            poll = min(poll * 2, poll_cap)
 
     # -- lifecycle -----------------------------------------------------------
 

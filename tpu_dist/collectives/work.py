@@ -1,0 +1,300 @@
+"""Async collective engine: ``Work`` futures over a per-ring ordered executor.
+
+The reference's whole performance story is DDP's Reducer firing bucketed
+all-reduces *asynchronously* so communication overlaps the backward pass
+(torch ``DistributedDataParallel``; README §1).  Our XLA path gets that
+overlap for free inside the jitted graph, but the host data plane — the
+path every CPU-backend job, chaos/elastic run, and store-transport job
+takes — was fully synchronous: each ``*_host`` collective blocked its
+caller until the last byte landed.
+
+``async_op=True`` on the eager collectives (and the
+:class:`~tpu_dist.collectives.bucketer.Bucketer`) now returns a
+:class:`Work` future instead, executed on an **ordered executor**:
+
+- **One FIFO worker thread per process** (the ``engine_for(None)``
+  engine — every production async path submits there, since a process has
+  one ring; per-:class:`DataPlane` engines exist for in-process
+  multi-rank test rigs, where each fake rank needs its own independent
+  stream).  Collectives on a ring are not independent jobs — every rank
+  must walk the same sequence of ring steps in the same order, so a
+  thread *pool* would let two in-flight collectives interleave their wire
+  traffic differently on different ranks.  A single ordered worker keeps
+  issue order == wire order == the order every peer sees, which is
+  exactly the NCCL stream-semantics contract torch's async ops rely on.
+- **Errors are captured at issue time, raised at ``wait()``.**  A
+  :class:`~tpu_dist.collectives.transport.PeerGoneError` or
+  :class:`~tpu_dist.analysis.sanitizer.CollectiveMismatchError` thrown
+  while the work executes is stored on the handle; ``wait()`` re-raises
+  it on the caller's thread, ``exception()`` exposes it without raising.
+  A dropped handle therefore silently swallows the diagnosis — which is
+  what tpudlint rule TD007 exists to catch.
+- **Sync collectives drain the queue first.**  A synchronous collective
+  issued after async work must not overtake it (ranks would disagree on
+  collective order — the sanitizer would flag it, and interleaved ring
+  tags would stall); every sync eager entry point calls
+  :func:`drain_pending` (a no-op lock check when nothing is queued).
+- **Queue-wait vs wire time are split on the flight recorder**: the span
+  a collective opens when it *executes* carries ``queue_ns`` — how long
+  the work sat behind earlier collectives — so an overlap regression
+  (bucket N stuck behind bucket N-1) is visible in the trace, not folded
+  into "the collective was slow".
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Callable, List, Optional, Sequence
+
+__all__ = ["Work", "wait_all", "drain_pending"]
+
+
+class Work:
+    """Handle for one asynchronously-issued collective (torch
+    ``dist.Work`` parity, future-flavored).
+
+    ``wait(timeout)`` blocks until the collective completes and returns
+    its result (the reduced/gathered value), re-raising any error the
+    collective hit while executing.  ``is_completed()`` polls without
+    blocking; ``exception()`` returns the captured error (None while
+    pending or on success).
+    """
+
+    __slots__ = ("_done", "_result", "_exc", "_label", "issued_ns",
+                 "started_ns", "site")
+
+    def __init__(self, label: str = "work"):
+        self._done = threading.Event()
+        self._result = None
+        self._exc: Optional[BaseException] = None
+        self._label = label
+        self.issued_ns = time.monotonic_ns()
+        self.started_ns: Optional[int] = None
+        self.site: Optional[str] = None   # caller's call-site at issue
+
+    # -- executor side -------------------------------------------------------
+
+    def _finish(self, result=None, exc: Optional[BaseException] = None):
+        self._result = result
+        self._exc = exc
+        self._done.set()
+
+    # -- caller side ---------------------------------------------------------
+
+    def wait(self, timeout: Optional[float] = None):
+        """Block until the work completes; returns its result.  Re-raises
+        the error captured at issue/execution time (``PeerGoneError``,
+        ``CollectiveMismatchError``, ...).  Raises ``TimeoutError`` if the
+        work is still in flight after ``timeout`` seconds — the work keeps
+        running and may be waited on again."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"async collective {self._label!r} still in flight after "
+                f"{timeout}s (wait again, or check exception())")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+    def is_completed(self) -> bool:
+        """True once the collective finished (successfully or not)."""
+        return self._done.is_set()
+
+    def exception(self) -> Optional[BaseException]:
+        """The error captured while the work executed, or None (also None
+        while the work is still pending)."""
+        return self._exc if self._done.is_set() else None
+
+    def result(self, timeout: Optional[float] = None):
+        """Alias for :meth:`wait` (concurrent.futures flavor)."""
+        return self.wait(timeout)
+
+    def __repr__(self):
+        state = ("pending" if not self._done.is_set()
+                 else "error" if self._exc is not None else "done")
+        return f"Work({self._label!r}, {state})"
+
+
+def completed_work(result, label: str = "work") -> Work:
+    """An already-finished :class:`Work` (single-process fast paths)."""
+    w = Work(label)
+    w._finish(result=result)
+    return w
+
+
+# thread-local marker + handoff slot: set while an executor worker runs a
+# body, so (a) drain_pending from inside a work cannot deadlock on its own
+# queue, and (b) the obs span the body opens can pick up its queue wait
+_tls = threading.local()
+
+
+def take_pending_queue_ns() -> Optional[int]:
+    """Pop the queue-wait (ns) of the work body currently executing on this
+    thread — consumed by the first flight-recorder span the body opens, so
+    the span splits time-behind-earlier-collectives from wire time."""
+    ns = getattr(_tls, "queue_ns", None)
+    _tls.queue_ns = None
+    return ns
+
+
+def pending_site() -> Optional[str]:
+    """The ISSUE call-site of the work body executing on this thread (not
+    consumed: every span the body opens attributes to it).  An engine
+    thread's own stack holds no user frames, so spans opened there would
+    otherwise attribute to framework internals."""
+    return getattr(_tls, "site", None)
+
+
+def _issue_site() -> Optional[str]:
+    """The submitting caller's call-site, captured only when the flight
+    recorder is armed (stack walks are not free)."""
+    try:
+        from ..obs import recorder as _rec
+        if _rec.enabled():
+            return _rec.call_site()
+    except Exception:
+        pass
+    return None
+
+
+class _OrderedExecutor:
+    """Single-worker FIFO executor: submitted bodies run in issue order,
+    one at a time — the per-ring stream.  The worker thread is lazy
+    (created on first submit) and daemon (dies with the process; a gang
+    teardown must not wait on queued diagnostics)."""
+
+    def __init__(self, name: str = "tpu_dist-async-coll"):
+        self._name = name
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self._q: deque = deque()
+        self._pending = 0          # queued + currently executing
+        self._thread: Optional[threading.Thread] = None
+
+    def submit(self, fn: Callable[[], object], label: str = "work") -> Work:
+        w = Work(label)
+        w.site = _issue_site()
+        with self._mu:
+            self._q.append((fn, w))
+            self._pending += 1
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True, name=self._name)
+                self._thread.start()
+            self._cv.notify_all()
+        return w
+
+    def _run(self):
+        while True:
+            with self._mu:
+                while not self._q:
+                    # park with a deadline so an idle engine's worker can
+                    # retire; a later submit starts a fresh one
+                    if not self._cv.wait(30.0) and not self._q:
+                        self._thread = None
+                        return
+                fn, w = self._q.popleft()
+            w.started_ns = time.monotonic_ns()
+            _tls.queue_ns = w.started_ns - w.issued_ns
+            _tls.site = w.site
+            _tls.on_engine = True
+            try:
+                w._finish(result=fn())
+            except BaseException as e:
+                w._finish(exc=e)
+            finally:
+                _tls.queue_ns = None
+                _tls.site = None
+                _tls.on_engine = False
+                with self._mu:
+                    self._pending -= 1
+                    self._cv.notify_all()
+
+    def pending(self) -> int:
+        with self._mu:
+            return self._pending
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Block until every previously-submitted work has finished
+        (results/errors stay on their handles).  Returns False on
+        timeout."""
+        deadline = (time.monotonic() + timeout) if timeout is not None \
+            else None
+        with self._mu:
+            while self._pending > 0:
+                left = (None if deadline is None
+                        else deadline - time.monotonic())
+                if left is not None and left <= 0:
+                    return False
+                self._cv.wait(left if left is not None else 1.0)
+        return True
+
+
+# -- engine registry ----------------------------------------------------------
+#
+# One ordered executor per ring (keyed by DataPlane instance, weakly — a
+# closed plane's engine dies with it), plus one process-wide executor for
+# collectives that never touch a ring (store-only payloads).  drain_pending
+# sweeps them all: sync collectives must order after EVERY queued async op.
+
+_engines: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_default_engine: Optional[_OrderedExecutor] = None
+_engines_mu = threading.Lock()
+
+
+def engine_for(dp=None) -> _OrderedExecutor:
+    """The ordered executor for ``dp``'s ring (or the process-wide one for
+    ``dp=None``)."""
+    global _default_engine
+    with _engines_mu:
+        if dp is None:
+            if _default_engine is None:
+                _default_engine = _OrderedExecutor()
+            return _default_engine
+        eng = _engines.get(dp)
+        if eng is None:
+            eng = _engines[dp] = _OrderedExecutor(
+                f"tpu_dist-async-coll-r{getattr(dp, 'rank', '?')}")
+        return eng
+
+
+def drain_pending(timeout: Optional[float] = None) -> None:
+    """Wait for every queued async collective (all engines) to finish.
+
+    Called at the top of every *sync* eager collective so sync ops cannot
+    overtake queued async ones (stream semantics).  No-op (one lock check
+    per engine) when nothing is queued, and a no-op from inside an
+    executor worker — a work body calling a sync collective must not wait
+    on its own queue."""
+    if getattr(_tls, "on_engine", False):
+        return  # executing ON an engine thread
+    with _engines_mu:
+        engines = list(_engines.values())
+        if _default_engine is not None:
+            engines.append(_default_engine)
+    for eng in engines:
+        eng.drain(timeout)
+
+
+def wait_all(works: Sequence[Work], timeout: Optional[float] = None) -> List:
+    """Wait on several :class:`Work` handles; returns their results in
+    order.  The first captured error re-raises (after all handles were
+    given their share of the deadline)."""
+    deadline = (time.monotonic() + timeout) if timeout is not None else None
+    out = []
+    for w in works:
+        left = None if deadline is None else max(0.0,
+                                                 deadline - time.monotonic())
+        out.append(w.wait(left))
+    return out
+
+
+def reset() -> None:
+    """Drop all engines (tests): queued work keeps running on orphaned
+    threads, but new submissions get fresh queues."""
+    global _default_engine
+    with _engines_mu:
+        _default_engine = None
+        _engines.clear()

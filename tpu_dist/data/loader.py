@@ -26,6 +26,8 @@ torch's worker-process model on purpose:
 from __future__ import annotations
 
 import collections
+import queue
+import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Iterator, List, Optional, Sequence
 
@@ -35,6 +37,20 @@ from .sampler import (BatchSampler, DistributedSampler, RandomSampler,
                       Sampler, SequentialSampler)
 
 __all__ = ["DataLoader", "DeviceLoader", "default_collate"]
+
+
+def _put_unless_stopped(q: "queue.Queue", stop: "threading.Event",
+                        item) -> bool:
+    """Blocking put that gives up when the consumer walked away; returns
+    True iff the item was delivered.  THE one stop-aware delivery loop —
+    regular batches and the terminal END/error item go through it alike."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.1)
+            return True
+        except queue.Full:
+            continue
+    return False
 
 
 def default_collate(samples: Sequence):
@@ -188,6 +204,18 @@ class DeviceLoader:
     *i+1..i+prefetch* (the pinned-memory/non_blocking idiom of
     /root/reference/mpspawn_dist.py:88,100-101, compiled away).
 
+    Staging runs on a **background fill thread**: host batch assembly
+    (index-gather, transforms, collate) AND the ``device_put`` dispatch
+    happen off the consumer thread, filling a bounded queue of ``prefetch``
+    staged batches.  The old design staged inline in the consumer loop, so
+    host assembly serialized against everything else the training thread
+    does — in particular the async bucketed gradient sync
+    (tpu_dist/collectives/bucketer.py), which only overlaps if the consumer
+    thread is free to run ahead.  Errors from the dataset/transform
+    propagate to the consumer at the batch where they occurred; abandoning
+    the iterator (the ``--max-steps`` break pattern) stops the thread and
+    releases the wrapped loader's workers.
+
     Multi-process placement (``local_shards``): with several processes (the
     reference's multi-node scenario), each process's DataLoader yields its
     OWN shard (DistributedSampler), and the global batch is their
@@ -268,15 +296,42 @@ class DeviceLoader:
             return placed
 
         it = iter(self.loader)
-        buf: collections.deque = collections.deque()
+        q: queue.Queue = queue.Queue(maxsize=self.prefetch)
+        stop = threading.Event()
+        END = object()
+
+        def fill():
+            # assemble + stage ahead of the consumer, up to `prefetch`
+            # staged batches; a full queue blocks HERE (bounded memory),
+            # re-checking `stop` so an abandoned iterator releases us
+            try:
+                for batch in it:
+                    if not _put_unless_stopped(q, stop, (None, stage(batch))):
+                        return
+                _put_unless_stopped(q, stop, (None, END))
+            except BaseException as e:  # propagate to the consumer
+                _put_unless_stopped(q, stop, (e, None))
+            finally:
+                close = getattr(it, "close", None)
+                if close is not None:
+                    close()
+
+        thread = threading.Thread(target=fill, daemon=True,
+                                  name="tpu_dist-device-loader-fill")
+        thread.start()
         try:
-            for batch in it:
-                buf.append(stage(batch))
-                if len(buf) > self.prefetch:
-                    yield buf.popleft()
-            while buf:
-                yield buf.popleft()
+            while True:
+                exc, item = q.get()
+                if exc is not None:
+                    raise exc
+                if item is END:
+                    break
+                yield item
         finally:
-            close = getattr(it, "close", None)
-            if close is not None:
-                close()
+            stop.set()
+            while True:  # unblock a producer parked on a full queue
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            thread.join(timeout=5.0)

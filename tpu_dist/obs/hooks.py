@@ -97,7 +97,22 @@ def collective_span(op: str, value=None, reduce_op=None, src=None, dst=None,
     rec = recorder.get_recorder()
     if rec is None:
         return _NULL
-    fields = {"site": recorder.call_site()}
+    fields = {}
+    try:
+        # async collectives: spans opened on the ordered engine attribute
+        # to the ISSUE call-site (the engine thread's own stack holds no
+        # user frames), and the first span additionally carries queue_ns —
+        # how long the work sat behind earlier collectives — split from
+        # wire time (both slots set by the engine around the body)
+        from ..collectives.work import pending_site, take_pending_queue_ns
+        qns = take_pending_queue_ns()
+        if qns is not None:
+            fields["queue_ns"] = qns
+        fields["site"] = pending_site()
+    except Exception:
+        pass
+    if not fields.get("site"):
+        fields["site"] = recorder.call_site()
     if kind == "collective":
         fields["coll"] = rec.next_coll()
     if reduce_op is not None:

@@ -99,7 +99,11 @@ def call_site(skip_parts=_SITE_SKIP) -> str:
     frame = inspect.currentframe()
     try:
         while frame is not None:
-            fname = frame.f_code.co_filename
+            # normpath: a module imported through an unnormalized sys.path
+            # entry (e.g. examples/ scripts inserting "<repo>/examples/..")
+            # carries that path in co_filename verbatim — it must still
+            # match the normalized skip prefixes
+            fname = os.path.normpath(frame.f_code.co_filename)
             if not fname.startswith(skip):
                 return f"{os.path.basename(fname)}:{frame.f_lineno}"
             frame = frame.f_back
