@@ -1,0 +1,257 @@
+"""Benchmark: ZeRO sharded optimizer step vs replicated update.
+
+The ISSUE 6 acceptance quantity: step throughput and measured
+optimizer-state bytes/rank of the two optimizer disciplines on the
+bench_overlap 64-leaf mixed-size gradient tree, worlds 1-4, over the SAME
+p2p ring data plane:
+
+- **replicated** — the PR 5 training-loop shape: bucketed async
+  all-reduce (issue, overlap input staging, ``wait_all``) + a fully
+  replicated Adam update over the whole 64-leaf tree on every rank;
+- **zero** — :class:`tpu_dist.parallel.ZeroOptimizer`: bucketed
+  reduce-scatter (half the sync wire a rank must wait for), wrapped Adam
+  on the flat owned shard only (1/world of the elements, a handful of
+  fused dispatches instead of 64 x ~8), and the parameter all-gather
+  issued async and waited lazily after the next step's input staging.
+
+Each step performs the same input-staging work (a seeded rng batch fill —
+the DeviceLoader-prefetch stand-in the async collectives overlap).  Every
+row carries ``opt_state_bytes_per_rank`` measured off the live state
+pytree, so the memory /= world claim is data, not arithmetic::
+
+    {"metric": "zero_step", "mode": "zero", "world": 4, "leaves": 64,
+     "value": 3.1, "unit": "steps/s", "opt_state_bytes_per_rank": 4793348}
+
+plus a ``zero_vs_replicated_w4`` summary line (acceptance: >= 1.5).
+``--smoke`` runs world 2 with a small tree, cross-checks the ZeRO
+parameters bitwise against the replicated update, and is wired as a
+tier-1 test (tests/test_zero.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_MODES = ("replicated", "zero")
+
+
+def _leaf_sizes(smoke: bool):
+    from benchmarks.bench_overlap import _leaf_sizes as overlap_sizes
+    return overlap_sizes(smoke)
+
+
+# ---------------------------------------------------------------------------
+# worker
+# ---------------------------------------------------------------------------
+
+def _worker() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    import numpy as np
+
+    from tpu_dist.dist.store import TCPStore
+
+    rank = int(os.environ["RANK"])
+    world = int(os.environ["WORLD_SIZE"])
+    spec = json.loads(os.environ["BENCH_SPEC"])
+    host, _, port = os.environ["TPU_DIST_STORE_ADDR"].rpartition(":")
+    store = TCPStore(host, int(port))
+    rdzv = importlib.import_module("tpu_dist.dist.rendezvous")
+    rdzv._store = store
+
+    class _Group:
+        def __init__(self, rank, num_processes):
+            self.rank, self.num_processes = rank, num_processes
+
+    g = _Group(rank, world)
+    from tpu_dist import collectives as C
+    from tpu_dist import optim
+    from tpu_dist.parallel import ZeroOptimizer
+
+    # every leaf rides the ring: the comparison is the optimizer
+    # discipline, not transport routing
+    os.environ["TPU_DIST_DP_THRESHOLD"] = "0"
+    sizes = spec["sizes"]
+    params0 = {f"leaf{i:03d}": (np.random.default_rng(77 + i)
+                                .standard_normal(n).astype(np.float32))
+               for i, n in enumerate(sizes)}       # identical on all ranks
+    grads = {k: (np.random.default_rng(1000 * (rank + 1) + i)
+                 .standard_normal(v.size).astype(np.float32)
+                 .reshape(v.shape) * 0.01)
+             for i, (k, v) in enumerate(params0.items())}
+    nbytes = sum(a.nbytes for a in params0.values())
+
+    stage_rng = np.random.default_rng(rank)
+
+    def stage():
+        # input-staging stand-in: the host work (batch assembly / rng /
+        # copy) a DeviceLoader prefetch performs while the async
+        # collective is in flight
+        return stage_rng.standard_normal(64 * 1024).astype(np.float32)
+
+    def opt_bytes(state):
+        return int(sum(np.asarray(a).nbytes
+                       for a in jax.tree.leaves(
+                           jax.tree.map(np.asarray, state))))
+
+    def run_replicated(iters):
+        params = {k: v.copy() for k, v in params0.items()}
+        opt = optim.Adam(1e-3)
+        opt_state = opt.init(params)
+        bucketer = C.Bucketer()
+        for _ in range(iters):
+            work = bucketer.all_reduce(grads, op="avg", group=g)
+            stage()
+            gsync = work.wait_all(timeout=600)
+            params, opt_state = opt.update(gsync, opt_state, params)
+        params = jax.tree.map(np.asarray, params)
+        return params, opt_bytes(opt_state)
+
+    def run_zero(iters):
+        params = {k: v.copy() for k, v in params0.items()}
+        zopt = ZeroOptimizer(optim.Adam(1e-3), group=g)
+        zstate = zopt.init(params)
+        handle = None
+        for _ in range(iters):
+            stage()
+            if handle is not None:
+                params = handle.wait(timeout=600)   # lazily waited gather
+            rs = zopt.reduce_scatter(grads, group=g)
+            handle, zstate = zopt.update(rs, zstate, group=g)
+        params = handle.wait(timeout=600)
+        return params, opt_bytes(zstate["opt"])
+
+    runners = {"replicated": run_replicated, "zero": run_zero}
+
+    if spec.get("check"):
+        # the ZeRO parameters must be BITWISE equal to the replicated
+        # update's after the same number of steps
+        ref, _ = run_replicated(2)
+        got, _ = run_zero(2)
+        for k in ref:
+            assert np.asarray(ref[k]).tobytes() == \
+                np.asarray(got[k]).tobytes(), f"zero != replicated for {k}"
+
+    rows = []
+    for mode in _MODES:
+        runners[mode](1)   # warm-up: peer connections, engine, jit caches
+        store.barrier(world, tag=f"bench-{mode}")
+        t0 = time.perf_counter()
+        _, state_bytes = runners[mode](spec["iters"])
+        dt = time.perf_counter() - t0
+        rows.append({"metric": "zero_step", "mode": mode, "world": world,
+                     "leaves": len(sizes), "bytes": nbytes,
+                     "iters": spec["iters"],
+                     "value": round(spec["iters"] / dt, 2),
+                     "unit": "steps/s",
+                     "opt_state_bytes_per_rank": state_bytes})
+    if rank == 0:
+        with open(os.environ["BENCH_OUT"], "w") as f:
+            json.dump(rows, f)
+    store.barrier(world, tag="bench-exit")
+    store.close()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def _run_world(world: int, smoke: bool, iters: int, out_path: str):
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from tpu_dist.dist.store import TCPStore
+
+    store = TCPStore(is_master=True)
+    procs = []
+    try:
+        env = dict(os.environ,
+                   TPU_DIST_STORE_ADDR=f"127.0.0.1:{store.port}",
+                   WORLD_SIZE=str(world),
+                   PYTHONPATH=_REPO + os.pathsep + os.environ.get(
+                       "PYTHONPATH", ""),
+                   JAX_PLATFORMS="cpu",
+                   BENCH_OUT=out_path,
+                   BENCH_SPEC=json.dumps({"sizes": _leaf_sizes(smoke),
+                                          "iters": iters, "check": smoke}))
+        env.pop("TPU_DIST_RESTART_COUNT", None)
+        env.pop("TPU_DIST_DP_THRESHOLD", None)
+        procs = [subprocess.Popen(
+            [sys.executable, "-m", "benchmarks.bench_zero", "--worker"],
+            env=dict(env, RANK=str(r)), cwd=_REPO)
+            for r in range(world)]
+        deadline = time.monotonic() + 600
+        rcs = [p.wait(timeout=max(1, deadline - time.monotonic()))
+               for p in procs]
+        if any(rcs):
+            raise RuntimeError(f"bench workers failed: rcs={rcs}")
+    finally:
+        for p in procs:  # a hung/failed world must not leak workers
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        store.close()
+    with open(out_path) as f:
+        return json.load(f)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--smoke", action="store_true",
+                    help="world=2, 16-leaf tree, bitwise zero-vs-replicated "
+                         "cross-check; seconds (tier-1)")
+    ap.add_argument("--worlds", type=int, nargs="*", default=None)
+    ap.add_argument("--iters", type=int, default=0,
+                    help="per-mode iterations (0 = auto)")
+    args = ap.parse_args(argv)
+    if args.worker:
+        return _worker()
+
+    worlds = args.worlds or ([2] if args.smoke else [1, 2, 3, 4])
+    iters = args.iters or (2 if args.smoke else 4)
+    all_rows = []
+    import tempfile
+    for world in worlds:
+        with tempfile.NamedTemporaryFile(mode="w", suffix=".json",
+                                         delete=False) as tmp:
+            out_path = tmp.name
+        try:
+            rows = _run_world(world, args.smoke, iters, out_path)
+        finally:
+            try:
+                os.unlink(out_path)
+            except OSError:
+                pass
+        for row in rows:
+            if args.smoke:
+                row["smoke"] = True
+            print(json.dumps(row))
+        all_rows.extend(rows)
+
+    # the ISSUE 6 acceptance quantities, when their configuration ran
+    by_key = {(r["mode"], r["world"]): r for r in all_rows}
+    zero = by_key.get(("zero", 4))
+    repl = by_key.get(("replicated", 4))
+    if zero and repl:
+        print(json.dumps({"metric": "zero_vs_replicated_w4",
+                          "value": round(zero["value"] / repl["value"], 2),
+                          "unit": "x", "threshold": 1.5}))
+        print(json.dumps({
+            "metric": "zero_opt_state_fraction_w4",
+            "value": round(zero["opt_state_bytes_per_rank"]
+                           / repl["opt_state_bytes_per_rank"], 4),
+            "unit": "of replicated", "expected": round(1 / 4, 4)}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, _REPO)
+    sys.exit(main())

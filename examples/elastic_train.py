@@ -26,6 +26,15 @@ plane, so the host work between issue and ``wait_all`` overlaps the sync —
 and it works on any backend, including CPU test rigs where XLA has no
 multiprocess computations.  On real TPU slices prefer the fused in-step
 all-reduce (`tpu_dist.parallel.DistributedDataParallel`).
+
+``--zero`` switches the update to ZeRO-1/2
+(:class:`tpu_dist.parallel.ZeroOptimizer`, docs/zero.md): gradients stop
+at the reduce-scatter phase, each rank keeps optimizer state only for the
+chunks it owns (state memory / world), and the updated parameters come
+back through an async all-gather waited lazily — the next step's batch
+assembly runs under the wire.  Checkpoints then store each rank's
+optimizer shard separately (world-size-pinned: resume at the same
+``--nproc_per_node``).
 """
 
 import argparse
@@ -45,6 +54,10 @@ def main():
     parser.add_argument("--lr", default=0.01, type=float)
     parser.add_argument("--ckpt-root", default="./ckpt_elastic")
     parser.add_argument("--save-every", default=25, type=int)
+    parser.add_argument("--zero", action="store_true",
+                        help="ZeRO-1/2: reduce-scatter grads, shard the "
+                             "optimizer state/update, overlap the param "
+                             "all-gather")
     args = parser.parse_args()
 
     if args.backend == "cpu":
@@ -94,6 +107,38 @@ def main():
 
     log = MetricLogger(every=25, fmt="[elastic] step {step} loss {loss:.4f}")
     params0 = model.init(jax.random.PRNGKey(0))
+
+    if args.zero:
+        from tpu_dist.parallel import ZeroOptimizer
+        zopt = ZeroOptimizer(opt, group=pg)
+        with resilience.TrainState(args.ckpt_root,
+                                   save_every=args.save_every, keep=3,
+                                   shard=(rank, nproc),
+                                   sharded_keys=("zero",)) as ts:
+            state, start = ts.resume({"params": params0,
+                                      "zero": zopt.init(params0)})
+            params, zstate = state["params"], state["zero"]
+            if start:
+                rank_zero_print(f"[elastic] resumed at step {start} (ZeRO)")
+            handle = None
+            for step in range(start, args.max_steps):
+                x, y = batch(step)          # staged under the in-flight …
+                if handle is not None:
+                    params = handle.wait(timeout=300)  # … param gather
+                l, g = fwd_bwd(params, x, y)
+                rs = zopt.reduce_scatter(jax.tree.map(np.asarray, g),
+                                         group=pg)
+                loss_now = float(l)         # overlaps the reduce-scatter
+                handle, zstate = zopt.update(rs, zstate, group=pg)
+                log.push(step=step, loss=loss_now)
+                if args.save_every and step % args.save_every == 0:
+                    params = handle.wait(timeout=300)  # checkpoint needs it
+                ts.end_step({"params": params, "zero": zstate}, step)
+            params = handle.wait(timeout=300) if handle is not None \
+                else params
+        rank_zero_print(f"[elastic] done at step {args.max_steps}")
+        return
+
     bucketer = C.Bucketer()  # bucketed async grad sync (25 MiB buckets)
     with resilience.TrainState(args.ckpt_root, save_every=args.save_every,
                                keep=3) as ts:

@@ -162,6 +162,36 @@ def sync_grads(g, group, bucketer):
     return g
 """
 
+# ZeRO-era issuers (ISSUE 6): reduce_scatter returns the in-flight shard
+# handle, ZeroOptimizer.update returns the async param-gather handle
+TD007_ZERO_POS = """
+def train_step(zopt, bucketer, grads, zstate):
+    bucketer.reduce_scatter(grads, op="avg")
+    zopt.update(grads, zstate)
+"""
+
+# the lazily-waited param gather held in state is NOT a dropped handle:
+# the handle is unpacked, stored, and waited at the top of the next step
+TD007_ZERO_NEG = """
+def train_step(zopt, grads, state, zstate):
+    rs = zopt.reduce_scatter(grads)
+    handle, zstate = zopt.update(rs, zstate)
+    state["params_handle"] = handle        # waited after the next prefetch
+    return state, zstate
+
+
+def next_step(state):
+    return state["params_handle"].wait(timeout=300)
+"""
+
+# .update() on ordinary containers whose names merely CONTAIN "zero" must
+# not lint as a dropped async handle (dict/set/Counter update is everywhere)
+TD007_DICT_UPDATE_NEG = """
+def collect(stats_zero, nonzero_counts):
+    stats_zero.update({"n": 1})
+    nonzero_counts.update(x=2)
+"""
+
 
 class TestRules:
     @pytest.mark.parametrize("rule,pos,neg", [
@@ -244,6 +274,23 @@ class TestRules:
     def test_td007_bare_expression_is_error(self):
         (f,) = lint_source(TD007_POS, "t.py")
         assert f.severity == "error" and "async_op=True" in f.message
+
+    def test_td007_zero_issuers_flag_bare_drops(self):
+        # bucketer.reduce_scatter and zopt.update both return handles the
+        # caller must hold (shards / async param gather)
+        found = lint_source(TD007_ZERO_POS, "t.py")
+        assert _rules(found) == ["TD007", "TD007"]
+        assert all(f.severity == "error" for f in found)
+
+    def test_td007_lazily_waited_gather_handle_passes(self):
+        # the ZeRO loop shape: handle unpacked, parked in state, waited at
+        # the top of the next step — no dropped-handle false positive
+        assert _rules(lint_source(TD007_ZERO_NEG, "t.py")) == []
+
+    def test_td007_plain_dict_update_named_zero_passes(self):
+        # only zopt/zero_opt/zerooptimizer receivers count for .update —
+        # a dict named stats_zero is not an async issuer
+        assert _rules(lint_source(TD007_DICT_UPDATE_NEG, "t.py")) == []
 
     def test_syntax_error_is_td000(self):
         (f,) = lint_source("def broken(:\n", "bad.py")

@@ -94,11 +94,11 @@ _TRAIN_WORKER = textwrap.dedent("""
 
 
 def _launch_train(tmp_path, tag, chaos=None, max_restarts=0, n_steps=10,
-                  timeout=420):
+                  timeout=420, worker_src=None):
     out_dir = tmp_path / tag
     out_dir.mkdir()
-    script = tmp_path / "train_worker.py"
-    script.write_text(_TRAIN_WORKER)
+    script = tmp_path / f"train_worker_{tag}.py"
+    script.write_text(worker_src or _TRAIN_WORKER)
     env = dict(os.environ)
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
     # 4 virtual devices per process, the known-good CPU multiprocess
@@ -168,6 +168,103 @@ def test_kill_with_max_restarts_zero_stays_fail_fast(tmp_path):
     assert "relaunching" not in r.stderr
     assert not (out_dir / "final0.json").exists()
     assert not (out_dir / "final1.json").exists()
+
+
+# ZeRO variant of THE acceptance run (ISSUE 6): the optimizer is a
+# ZeroOptimizer — gradients reduce-scatter, momentum state lives sharded
+# per rank (checkpointed per rank, world-size-pinned), parameters come back
+# through the async chunk all-gather.  Same batch keying, so the resumed
+# trajectory must still be bit-identical: the reduce-scattered shard is the
+# all-reduce's owned span and the update is elementwise, so sharding may
+# not move a single bit.
+_ZERO_TRAIN_WORKER = textwrap.dedent("""
+    import hashlib, json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import tpu_dist.dist as dist
+    from tpu_dist import optim, resilience
+    from tpu_dist.models import ConvNet
+    from tpu_dist.nn import functional as F
+    from tpu_dist.parallel import ZeroOptimizer
+
+    out_dir, ckpt_root, n_steps = sys.argv[1], sys.argv[2], int(sys.argv[3])
+    pg = dist.init_process_group(backend="cpu", init_method="env://")
+    rank, nproc = dist.get_rank(), dist.get_num_processes()
+
+    model = ConvNet()
+    params0 = model.init(jax.random.PRNGKey(0))
+    zopt = ZeroOptimizer(optim.SGD(lr=0.05, momentum=0.9), group=pg)
+
+    def batch(step, r):
+        g = np.random.default_rng(10_000 * (r + 1) + step)
+        x = g.standard_normal((8, 28, 28, 1)).astype(np.float32)
+        y = g.integers(0, 10, size=(8,)).astype(np.int32)
+        return x, y
+
+    @jax.jit
+    def fwd_bwd(params, x, y):
+        def loss(p):
+            return F.cross_entropy(model.apply(p, x), y)
+        return jax.value_and_grad(loss)(params)
+
+    losses = {}
+    with resilience.TrainState(ckpt_root, save_every=5, keep=None,
+                               shard=(rank, nproc),
+                               sharded_keys=("zero",)) as ts:
+        state, start = ts.resume({"params": params0,
+                                  "zero": zopt.init(params0)})
+        params, zstate = state["params"], state["zero"]
+        for step in range(start, n_steps):
+            x, y = batch(step, rank)
+            l, g = fwd_bwd(params, x, y)
+            rs = zopt.reduce_scatter(jax.tree.map(np.asarray, g), group=pg)
+            loss_now = float(l)      # overlaps the in-flight reduce-scatter
+            handle, zstate = zopt.update(rs, zstate, group=pg)
+            params = handle.wait(timeout=300)
+            losses[step] = loss_now
+            ts.end_step({"params": params, "zero": zstate}, step)
+
+    leaves = [np.asarray(a, np.float32).ravel()
+              for a in jax.tree_util.tree_leaves(params)]
+    digest = hashlib.sha256(np.concatenate(leaves).tobytes()).hexdigest()
+    with open(os.path.join(out_dir, f"final{rank}.json"), "w") as f:
+        json.dump({"rank": rank, "start": start,
+                   "generation": dist.generation(),
+                   "losses": {str(k): v for k, v in losses.items()},
+                   "params_sha256": digest}, f)
+    dist.destroy_process_group()
+""")
+
+
+@pytest.mark.zero
+def test_zero_kill_restart_resume_bitwise(tmp_path):
+    """ISSUE 6 chaos acceptance: kill a rank at step 5 of a ZeRO training
+    job → supervised restart → every rank restores the replicated params
+    AND its own sharded optimizer state at the agreed step, and the final
+    trajectory + parameters match an uninterrupted ZeRO run bit-for-bit."""
+    ra, dir_a = _launch_train(tmp_path, "zero_interrupted",
+                              chaos="kill:rank=1,step=5", max_restarts=1,
+                              worker_src=_ZERO_TRAIN_WORKER)
+    assert ra.returncode == 0, f"stdout:\n{ra.stdout}\nstderr:\n{ra.stderr}"
+    assert "relaunching" in ra.stderr
+
+    rb, dir_b = _launch_train(tmp_path, "zero_clean",
+                              worker_src=_ZERO_TRAIN_WORKER)
+    assert rb.returncode == 0, f"stdout:\n{rb.stdout}\nstderr:\n{rb.stderr}"
+
+    fa, fb = _finals(dir_a), _finals(dir_b)
+    for rank in (0, 1):
+        assert fa[rank]["generation"] == 1, fa[rank]
+        assert fa[rank]["start"] == 6, fa[rank]
+        assert fb[rank]["generation"] == 0 and fb[rank]["start"] == 0
+        for step in range(6, 10):
+            assert fa[rank]["losses"][str(step)] == \
+                fb[rank]["losses"][str(step)], f"step {step} diverged"
+    digests = {f["params_sha256"] for f in (*fa.values(), *fb.values())}
+    assert len(digests) == 1, f"parameter divergence: {digests}"
 
 
 # Hung-rank worker: publishes heartbeats, then rank 1's beat is stalled by

@@ -537,8 +537,14 @@ _ASYNC_ISSUERS = COLLECTIVE_CALLS | {"send", "recv"}
 
 def _is_async_call(node: ast.AST) -> bool:
     """A call that returns a Work-like handle: any collective/p2p call with
-    a truthy-constant ``async_op=``, or ``<bucketer>.all_reduce(...)``
-    (always returns a BucketWork)."""
+    a truthy-constant ``async_op=``; ``<bucketer>.all_reduce(...)`` /
+    ``<bucketer>.reduce_scatter(...)`` (always return a BucketWork); or a
+    ZeRO optimizer's handle-returning calls (``<zero-ish>.update(...)``
+    yields the async param-gather handle, ``<zero-ish>.reduce_scatter(...)``
+    the in-flight gradient shards).  Handles *held* somewhere — a tuple
+    unpack, an attribute, a container — count as used; only a
+    bare-expression drop or a never-read name fires, so the lazily-waited
+    param gather a train loop keeps in state is not a finding."""
     if not isinstance(node, ast.Call):
         return False
     name = _terminal_name(node.func)
@@ -547,9 +553,18 @@ def _is_async_call(node: ast.AST) -> bool:
             if kw.arg == "async_op" and isinstance(kw.value, ast.Constant):
                 return bool(kw.value.value)
         return False
-    if name == "all_reduce" and isinstance(node.func, ast.Attribute):
-        recv_name = (_dotted(node.func.value) or "").lower()
-        return "bucketer" in recv_name
+    if not isinstance(node.func, ast.Attribute):
+        return False
+    recv_name = (_dotted(node.func.value) or "").lower()
+    if name in ("all_reduce", "reduce_scatter") \
+            and ("bucketer" in recv_name or "zopt" in recv_name
+                 or "zero" in recv_name):
+        return True
+    # .update() is ubiquitous (dict/set/Counter) — only receivers that
+    # unambiguously name a ZeRO optimizer count, not any *zero* substring
+    if name == "update" and ("zopt" in recv_name or "zeroopt" in recv_name
+                             or "zero_opt" in recv_name):
+        return True
     return False
 
 

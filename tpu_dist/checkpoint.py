@@ -41,7 +41,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
-__all__ = ["save", "restore", "latest_step", "all_steps",
+__all__ = ["save", "restore", "latest_step", "all_steps", "shard_root",
            "AsyncCheckpointer", "GracefulShutdown"]
 
 _STEP_DIR = re.compile(r"^step_(\d{8})$")
@@ -81,8 +81,17 @@ def _participate_in_gather(tree) -> None:
             _materialize(leaf)
 
 
+def shard_root(root: str, rank: int) -> str:
+    """The per-rank checkpoint root for rank-sharded state (ZeRO optimizer
+    shards): ``<root>/shard_r{rank:03d}``.  Each rank owns its directory
+    outright, so the atomic tmp+rename machinery applies unchanged and
+    ranks never race on one ``arrays.npz``."""
+    return os.path.join(root, f"shard_r{int(rank):03d}")
+
+
 def save(root: str, tree: Any, step: int, metadata: Optional[Dict] = None,
-         keep: Optional[int] = None) -> str:
+         keep: Optional[int] = None,
+         shard: Optional[tuple] = None) -> str:
     """Write checkpoint ``root/step_{step:08d}``; returns its path.
 
     ``keep=N`` prunes to the newest N step dirs after a successful write.
@@ -91,8 +100,24 @@ def save(root: str, tree: Any, step: int, metadata: Optional[Dict] = None,
     of those leaves is a collective.  Non-zero processes return the target
     path without touching disk (call :func:`tpu_dist.dist.barrier` after if
     you need completion before proceeding).
+
+    ``shard=(rank, world)`` writes **rank-sharded** state (per-rank ZeRO
+    optimizer shards, tpu_dist/parallel/zero.py): EVERY rank writes its own
+    tree — which differs per rank by design — under
+    :func:`shard_root`, with the shard coordinates recorded in the
+    metadata so :func:`restore` can refuse a world-size mismatch loudly.
     """
     import jax
+
+    if shard is not None:
+        rank, world = int(shard[0]), int(shard[1])
+        sroot = shard_root(root, rank)
+        path = os.path.join(sroot, f"step_{step:08d}")
+        meta = dict(metadata or {})
+        meta["shard_rank"], meta["shard_world"] = rank, world
+        arrays = {k: _materialize(v) for k, v in _flatten(tree).items()}
+        _write(sroot, path, arrays, step, meta, keep)
+        return path
 
     path = os.path.join(root, f"step_{step:08d}")
     if jax.process_index() != 0:
@@ -278,7 +303,8 @@ def latest_step(root: str) -> Optional[int]:
 
 
 def restore(root: str, template: Any, step: Optional[int] = None,
-            sharding=None, verify: bool = False) -> Any:
+            sharding=None, verify: bool = False,
+            shard: Optional[tuple] = None) -> Any:
     """Load a checkpoint into the structure of ``template``.
 
     ``step=None`` loads the latest.  ``sharding`` controls device placement:
@@ -289,12 +315,19 @@ def restore(root: str, template: Any, step: Optional[int] = None,
     time before deserializing — the load-time check for a checkpoint
     corrupted after commit (bit rot, partial copy, crash without fsync).
 
+    ``shard=(rank, world)`` loads this rank's rank-sharded state (see
+    :func:`save`): the recorded shard coordinates must match exactly —
+    sharded checkpoints are world-size-pinned until elastic resharding
+    (ROADMAP item 1) can redistribute them.
+
     Raises with a precise message when the tree structure or a leaf
     shape/dtype does not match the template — resuming into a changed model
     must fail loudly, not load garbage.
     """
     import jax
 
+    if shard is not None:
+        root = shard_root(root, int(shard[0]))
     if step is None:
         step = latest_step(root)
         if step is None:
@@ -302,6 +335,17 @@ def restore(root: str, template: Any, step: Optional[int] = None,
     path = os.path.join(root, f"step_{step:08d}")
     with open(os.path.join(path, "tree.json")) as f:
         meta = json.load(f)
+    if shard is not None:
+        rank, world = int(shard[0]), int(shard[1])
+        rec = meta.get("metadata", {})
+        got = (rec.get("shard_rank"), rec.get("shard_world"))
+        if got != (rank, world):
+            raise ValueError(
+                f"sharded checkpoint at {path!r} was saved as rank "
+                f"{got[0]} of world {got[1]}, but this process is rank "
+                f"{rank} of world {world}.  Sharded optimizer state is "
+                f"world-size-pinned; resuming at a different world size "
+                f"needs elastic resharding (ROADMAP item 1).")
     npz_path = os.path.join(path, "arrays.npz")
     if verify:
         recorded = meta.get("arrays_sha256")

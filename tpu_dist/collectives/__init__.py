@@ -46,7 +46,8 @@ from .transport import DataPlane, PeerGoneError
 # gradient bucketer (DDP Reducer / Horovod tensor-fusion parity)
 from . import bucketer, work
 from .work import Work, wait_all
-from .bucketer import Bucketer, BucketWork, bucketed_all_reduce
+from .bucketer import (Bucketer, BucketWork, bucketed_all_reduce,
+                       bucketed_reduce_scatter)
 
 __all__ = [
     "all_reduce", "all_gather", "reduce_scatter", "broadcast", "all_to_all",
@@ -58,5 +59,5 @@ __all__ = [
     "scatter_object_list", "all_to_all_host",
     "ring", "transport", "DataPlane", "PeerGoneError",
     "work", "Work", "wait_all", "bucketer", "Bucketer", "BucketWork",
-    "bucketed_all_reduce",
+    "bucketed_all_reduce", "bucketed_reduce_scatter",
 ]

@@ -47,7 +47,7 @@ from typing import List, Optional
 import numpy as np
 
 __all__ = ["Bucketer", "BucketWork", "bucketed_all_reduce",
-           "DEFAULT_BUCKET_BYTES"]
+           "bucketed_reduce_scatter", "DEFAULT_BUCKET_BYTES"]
 
 DEFAULT_BUCKET_BYTES = 25 * 1024 * 1024  # torch DDP bucket_cap_mb parity
 
@@ -220,6 +220,33 @@ class Bucketer:
         thread, before returning), so the caller may mutate its arrays the
         moment this returns — no torch-style "don't touch until wait"
         hazard."""
+        return self._issue(tree, op, group, scatter=False)
+
+    def reduce_scatter(self, tree, op: str = "avg", group=None) -> BucketWork:
+        """Bucketed all-reduce **stopped at the reduce-scatter phase**:
+        ``wait_all()`` returns a tree of the same structure whose leaves are
+        this rank's **owned flat chunk** of each reduced leaf (1-D, span
+        ``ring.ring_chunk_span(leaf.size, world, rank)``; empty on ranks
+        that own no elements of a tiny leaf).
+
+        Because buckets are laid out chunk-major, bucket chunk *c* is
+        already the concatenation of every member leaf's own per-leaf ring
+        chunk *c* — so the shard this rank keeps is **bitwise-identical**
+        to the span a full :meth:`all_reduce` would have folded there (same
+        chunk owner ⇒ same accumulation order, same owner-side avg division
+        and ``comm_dtype`` re-quantization).  This is the ZeRO entry point:
+        update the owned shard only, then redistribute with
+        :func:`~tpu_dist.collectives.ring.ring_chunk_all_gather`
+        (tpu_dist/parallel/zero.py).
+
+        Leaves the ring cannot reduce coalesce onto one eager store
+        all-reduce and are sliced to the owned span locally — same shard
+        contract on every transport.  At world 1 the "shard" is the whole
+        (flattened) leaf.  Inputs are snapshotted at issue, like
+        :meth:`all_reduce`."""
+        return self._issue(tree, op, group, scatter=True)
+
+    def _issue(self, tree, op: str, group, scatter: bool) -> BucketWork:
         import jax
         from . import eager as _eager
         from .work import completed_work, engine_for
@@ -230,15 +257,19 @@ class Bucketer:
         if not pinned:
             group = _eager._default_group(group)
         n = self._dp.num_processes if pinned else group.num_processes
+        r = self._dp.rank if pinned else group.rank
+        kind_name = "bucket_reduce_scatter" if scatter else "bucket_all_reduce"
         leaves, treedef = jax.tree.flatten(tree)
         arrs = [np.asarray(l) for l in leaves]
-        label = f"bucket_all_reduce[{op}]x{len(arrs)}"
+        label = f"{kind_name}[{op}]x{len(arrs)}"
 
         if n <= 1:
             # copy, not views: the snapshot-at-issue contract must hold on
             # the single-process fast path too (the caller may clobber its
-            # arrays right after issue)
-            out = [np.array(a) for a in arrs]
+            # arrays right after issue).  The world-1 "shard" is the whole
+            # leaf, flattened — the degenerate bounds(size, 1) span.
+            out = [np.array(a).reshape(-1) if scatter else np.array(a)
+                   for a in arrs]
             return BucketWork(treedef, lambda results: out,
                               [completed_work(None, label)], label)
 
@@ -278,7 +309,8 @@ class Bucketer:
             # diverge ranks that packed at different times)
             packed = bucket.pack(n)
             works.append(engine.submit(
-                self._bucket_body(packed, op, n, group, issue_seq, bi),
+                self._bucket_body(packed, op, n, group, issue_seq, bi,
+                                  scatter),
                 label=f"{label}/bkt{bi}"))
             plans.append(("bucket", bucket))
         if rest_idx:
@@ -295,15 +327,33 @@ class Bucketer:
             plans.append(("rest", rest_idx))
 
         def assemble(results):
+            from .ring import _bounds
             out: List = [None] * len(arrs)
             for (kind, plan), res in zip(plans, results):
                 if kind == "bucket":
-                    flats = plan.unpack(res[0], n, res[1])
-                    for idx, flat in zip(plan.indices, flats):
-                        out[idx] = flat.reshape(arrs[idx].shape)
+                    if scatter:
+                        # the owned bucket chunk is the concat of member
+                        # leaves' own chunks, in member order — slice it
+                        # back into per-leaf shards
+                        chunk, leaf_bounds = res
+                        pos = 0
+                        for idx, b in zip(plan.indices, leaf_bounds):
+                            flo, fhi = b[r]
+                            out[idx] = np.array(chunk[pos:pos + fhi - flo])
+                            pos += fhi - flo
+                    else:
+                        flats = plan.unpack(res[0], n, res[1])
+                        for idx, flat in zip(plan.indices, flats):
+                            out[idx] = flat.reshape(arrs[idx].shape)
                 else:
                     for idx, val in zip(plan, res):
-                        out[idx] = np.asarray(val)
+                        a = np.asarray(val)
+                        if scatter:
+                            # store path has no scatter: slice the fully-
+                            # reduced value to the span this rank owns
+                            lo, hi = _bounds(a.size, n)[r]
+                            a = np.array(a.reshape(-1)[lo:hi])
+                        out[idx] = a
             return out
 
         return BucketWork(treedef, assemble, works, label)
@@ -317,12 +367,15 @@ class Bucketer:
             return s
 
     def _bucket_body(self, packed, op: str, n: int, group,
-                     issue_seq: int, bi: int):
+                     issue_seq: int, bi: int, scatter: bool = False):
         """The deferred per-bucket collective: ring all-reduce the
         (already-packed, issue-time-snapshotted) flat bucket with its
-        per-leaf-aligned bounds, return ``(reduced_flat, leaf_bounds)``.
-        Runs on the ordered engine."""
+        per-leaf-aligned bounds, return ``(reduced_flat, leaf_bounds)`` —
+        or, with ``scatter=True``, stop at the reduce-scatter phase and
+        return ``(owned_chunk, leaf_bounds)``.  Runs on the ordered
+        engine."""
         buf, bucket_bounds, leaf_bounds = packed
+        op_name = "bucket_reduce_scatter" if scatter else "bucket_all_reduce"
 
         def body():
             from . import eager as _eager
@@ -336,20 +389,24 @@ class Bucketer:
                 # sequence allocated HERE, in engine order — every rank
                 # submits the same buckets in the same order, so the k-th
                 # body draws the k-th seq on every rank
-                seq = _eager._next_seq("bucket_ar", 0)
+                seq = _eager._next_seq("bucket_rs" if scatter
+                                       else "bucket_ar", 0)
                 tag = f"{_eager._ns()}/coll/bkt/{seq}"
-                _eager._sanitize("bucket_all_reduce", group, store,
+                _eager._sanitize(op_name, group, store,
                                  value=buf, reduce_op=op)
                 dp = _eager._maybe_data_plane(group, store)
                 comm = _eager._comm_dtype()
-            with _eager._obs_span("bucket_all_reduce", value=buf,
-                                  reduce_op=op):
+            with _eager._obs_span(op_name, value=buf, reduce_op=op):
                 t0 = time.perf_counter()
-                reduced = _ring.ring_all_reduce(dp, buf, op=op, tag=tag,
-                                                comm_dtype=comm,
-                                                bounds=bucket_bounds)
-                _eager._record("bucket_all_reduce", "dataplane",
-                               buf.nbytes, t0)
+                if scatter:
+                    reduced = _ring.ring_reduce_scatter(
+                        dp, buf, op=op, tag=tag, comm_dtype=comm,
+                        bounds=bucket_bounds)
+                else:
+                    reduced = _ring.ring_all_reduce(dp, buf, op=op, tag=tag,
+                                                    comm_dtype=comm,
+                                                    bounds=bucket_bounds)
+                _eager._record(op_name, "dataplane", buf.nbytes, t0)
             return reduced, leaf_bounds
 
         return body
@@ -361,4 +418,13 @@ def bucketed_all_reduce(tree, op: str = "avg", group=None,
     coalesced + pipelined on the wire; the async win needs ``Bucketer``
     plus caller-side overlap)."""
     return Bucketer(bucket_bytes=bucket_bytes).all_reduce(
+        tree, op=op, group=group).wait_all()
+
+
+def bucketed_reduce_scatter(tree, op: str = "avg", group=None,
+                            bucket_bytes: Optional[int] = None):
+    """Synchronous convenience: bucketed reduce-scatter, waited inline —
+    returns this rank's owned flat shard of every leaf (see
+    :meth:`Bucketer.reduce_scatter`)."""
+    return Bucketer(bucket_bytes=bucket_bytes).reduce_scatter(
         tree, op=op, group=group).wait_all()

@@ -54,7 +54,8 @@ from typing import Optional, Tuple
 import numpy as np
 
 __all__ = ["ring_all_reduce", "ring_all_gather", "ring_reduce_scatter",
-           "tree_broadcast", "ring_chunk_span", "RING_OPS"]
+           "ring_chunk_all_gather", "tree_broadcast", "ring_chunk_span",
+           "RING_OPS"]
 
 # reduce ops the ring path implements; others (product, bitwise) stay on
 # the store path in eager.py
@@ -281,14 +282,7 @@ def ring_all_reduce(dp, x, op: str = "sum", tag: str = "ar",
     if bounds is None:
         bounds = _bounds(flat.size, n)
     else:
-        bounds = [(int(lo), int(hi)) for lo, hi in bounds]
-        if (len(bounds) != n or bounds[0][0] != 0
-                or bounds[-1][1] != flat.size
-                or any(bounds[i][1] != bounds[i + 1][0]
-                       for i in range(n - 1))):
-            raise ValueError(
-                f"bounds must be {n} contiguous spans covering "
-                f"[0, {flat.size}), got {bounds}")
+        bounds = _check_bounds(bounds, n, flat.size)
     utag = f"{tag}/rar"
     with _obs_span("ring_all_reduce", x):
         _reduce_scatter_phase(dp, flat, bounds, n, r, op, utag, wire)
@@ -304,17 +298,40 @@ def ring_all_reduce(dp, x, op: str = "sum", tag: str = "ar",
     return flat.astype(out_dtype, copy=False).reshape(x.shape)
 
 
+def _check_bounds(bounds, n: int, size: int):
+    bounds = [(int(lo), int(hi)) for lo, hi in bounds]
+    if (len(bounds) != n or bounds[0][0] != 0 or bounds[-1][1] != size
+            or any(bounds[i][1] != bounds[i + 1][0] for i in range(n - 1))):
+        raise ValueError(
+            f"bounds must be {n} contiguous spans covering [0, {size}), "
+            f"got {bounds}")
+    return bounds
+
+
 def ring_reduce_scatter(dp, x, op: str = "sum", tag: str = "rs",
-                        comm_dtype=None) -> np.ndarray:
+                        comm_dtype=None, bounds=None) -> np.ndarray:
     """Reduce-scatter phase alone: returns this rank's fully-reduced chunk
-    (flat 1-D; its span is :func:`ring_chunk_span`).  Uneven payloads give
-    the first ``size % world`` ranks one extra element."""
+    (flat 1-D; its span is :func:`ring_chunk_span`, or ``bounds[rank]`` when
+    a custom chunk partition is passed).  Uneven payloads give the first
+    ``size % world`` ranks one extra element.
+
+    The returned chunk is **bitwise-identical to the span a full
+    :func:`ring_all_reduce` would have folded there** — same chunk owner,
+    same accumulation order, same owner-side avg division and (under
+    ``comm_dtype``) the same owner re-quantization through the wire dtype
+    that the all-gather phase would have distributed.  That identity is
+    what lets ZeRO-style sharded optimizers (tpu_dist/parallel/zero.py)
+    stop here, update the owned shard, and still match the replicated
+    update bit-for-bit."""
     x, op, n, r, flat = _prepare(dp, x, op)
     out_dtype = _out_dtype(x.dtype, op)
     if n <= 1:
         return flat.astype(out_dtype)
     wire = np.dtype(comm_dtype) if comm_dtype is not None else None
-    bounds = _bounds(flat.size, n)
+    if bounds is None:
+        bounds = _bounds(flat.size, n)
+    else:
+        bounds = _check_bounds(bounds, n, flat.size)
     if flat.size:
         with _obs_span("ring_reduce_scatter", x):
             _reduce_scatter_phase(dp, flat, bounds, n, r, op, f"{tag}/rrs",
@@ -323,7 +340,38 @@ def ring_reduce_scatter(dp, x, op: str = "sum", tag: str = "rs",
     chunk = flat[lo:hi]
     if op in ("avg", "mean"):
         chunk = chunk / n
-    return chunk.astype(out_dtype, copy=False)
+    if wire is not None:
+        # owner re-quantization, exactly as ring_all_reduce performs before
+        # its all-gather phase: the shard this rank keeps must equal the
+        # compressed bytes every peer would have received
+        chunk = chunk.astype(wire).astype(flat.dtype)
+    # copy: the slice would otherwise pin the whole widened accumulation
+    # buffer alive for the lifetime of the (small) shard
+    return np.array(chunk.astype(out_dtype, copy=False))
+
+
+def ring_chunk_all_gather(dp, flat, bounds, tag: str = "cag") -> np.ndarray:
+    """All-gather of pre-owned chunks — the all-gather phase of the ring
+    alone, the inverse of :func:`ring_reduce_scatter`'s stop.
+
+    Every rank passes the same full-size 1-D ``flat`` buffer with its own
+    chunk ``bounds[rank]`` filled (the other spans are scratch); after
+    N-1 double-buffered ring steps every span holds its owner's bytes —
+    identical on every rank.  Fills ``flat`` in place and returns it.
+    This is how a ZeRO optimizer redistributes updated parameter shards
+    (tpu_dist/parallel/zero.py)."""
+    flat = np.asarray(flat)
+    if flat.ndim != 1:
+        raise ValueError(f"ring_chunk_all_gather wants a flat 1-D buffer, "
+                         f"got shape {flat.shape}")
+    n, r = dp.num_processes, dp.rank
+    if n <= 1 or flat.size == 0:
+        return flat
+    bounds = _check_bounds(bounds, n, flat.size)
+    with _obs_span("ring_chunk_all_gather", flat):
+        _all_gather_phase(dp, flat, bounds, n, r, f"{tag}/rcag",
+                          wire_dtype=None)
+    return flat
 
 
 def ring_all_gather(dp, x, tag: str = "ag") -> np.ndarray:

@@ -195,22 +195,31 @@ class _OrderedExecutor:
                     if not self._cv.wait(30.0) and not self._q:
                         self._thread = None
                         return
-                fn, w = self._q.popleft()
-            w.started_ns = time.monotonic_ns()
-            _tls.queue_ns = w.started_ns - w.issued_ns
-            _tls.site = w.site
-            _tls.on_engine = True
-            try:
-                w._finish(result=fn())
-            except BaseException as e:
-                w._finish(exc=e)
-            finally:
-                _tls.queue_ns = None
-                _tls.site = None
-                _tls.on_engine = False
-                with self._mu:
-                    self._pending -= 1
-                    self._cv.notify_all()
+                # BATCHED handoff: drain everything already queued under
+                # ONE lock acquisition and run it back-to-back.  A caller
+                # issuing N handles and immediately wait_all()-ing them
+                # (the per-leaf async pattern) used to pay a lock/CV
+                # round-trip per item; the batch pop amortizes that to one
+                # per burst, which is what keeps per-leaf async from
+                # regressing below per-leaf sync on small worlds.
+                batch = list(self._q)
+                self._q.clear()
+            for fn, w in batch:
+                w.started_ns = time.monotonic_ns()
+                _tls.queue_ns = w.started_ns - w.issued_ns
+                _tls.site = w.site
+                _tls.on_engine = True
+                try:
+                    w._finish(result=fn())
+                except BaseException as e:
+                    w._finish(exc=e)
+                finally:
+                    _tls.queue_ns = None
+                    _tls.site = None
+                    _tls.on_engine = False
+                    with self._mu:
+                        self._pending -= 1
+                        self._cv.notify_all()
 
     def pending(self) -> int:
         with self._mu:

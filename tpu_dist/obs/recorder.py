@@ -81,7 +81,10 @@ def _generation() -> int:
 
 
 # framework layers whose frames are instrumentation, not the user's line
-_SITE_SKIP = ("collectives", "obs", "analysis", "dist", "resilience")
+# (parallel: the ZeroOptimizer / DDP wrappers issue collectives from inside
+# tpu_dist.parallel — the user's line is their caller's, e.g. the train loop)
+_SITE_SKIP = ("collectives", "obs", "analysis", "dist", "resilience",
+              "parallel", "optim")
 
 
 def call_site(skip_parts=_SITE_SKIP) -> str:

@@ -6,6 +6,16 @@ function of the gradient pytree, so it fuses into the jitted step; under
 the DDP wrapper call it on the *averaged* gradients (inside a custom step)
 — the global norm is then identical on every replica, like torch DDP
 clipping after allreduce.
+
+**Sharded path (ZeRO)**: when each rank holds only its owned flat shard of
+every gradient leaf (``Bucketer.reduce_scatter``,
+tpu_dist/parallel/zero.py), :func:`sharded_global_norm` computes the local
+sum of squares over the owned chunks and folds the rank partials with ONE
+scalar host all-reduce — no rank ever materializes the full gradient.
+:func:`global_norm` accumulates over each leaf *flattened* so that a
+world-1 shard (the whole leaf, flat) produces the bit-identical partial
+sum: sharded clipping equals replicated clipping bitwise at world 1, and
+numerically (the rank partials associate differently) across worlds.
 """
 
 from __future__ import annotations
@@ -13,14 +23,21 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["clip_grad_norm", "global_norm"]
+__all__ = ["clip_grad_norm", "global_norm",
+           "sharded_clip_grad_norm", "sharded_global_norm"]
+
+
+def _leaf_sq(g) -> jax.Array:
+    # flattened before the sum: XLA's reduction order depends on layout, so
+    # flattening here is what lets a flat ZeRO shard covering the whole
+    # leaf (world 1) reproduce this partial bit-for-bit
+    return jnp.sum(jnp.square(jnp.reshape(g, (-1,)).astype(jnp.float32)))
 
 
 def global_norm(grads) -> jax.Array:
     """L2 norm over every leaf of the pytree (torch: total_norm)."""
     leaves = jax.tree.leaves(grads)
-    return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
-                        for g in leaves))
+    return jnp.sqrt(sum(_leaf_sq(g) for g in leaves))
 
 
 def clip_grad_norm(grads, max_norm: float):
@@ -33,3 +50,46 @@ def clip_grad_norm(grads, max_norm: float):
     scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
     return jax.tree.map(lambda g: (g.astype(jnp.float32) * scale
                                    ).astype(g.dtype), grads), norm
+
+
+def sharded_global_norm(shards, group=None, all_reduce=None) -> jax.Array:
+    """Global L2 norm from per-rank owned shards: local sum of squares over
+    this rank's fragments (same pytree structure as the gradient tree,
+    leaves = owned flat chunks) + one scalar host all-reduce.
+
+    Every element of every leaf is owned by exactly one rank
+    (``Bucketer.reduce_scatter``'s partition), so the summed partials cover
+    the gradient exactly once.  At world 1 (shards are whole flattened
+    leaves) this is bitwise-equal to :func:`global_norm`.
+
+    ``all_reduce`` overrides the scalar sum collective (signature
+    ``f(np.float32 scalar) -> scalar``) — in-process multi-rank test rigs
+    route it over a pinned DataPlane; the default is the eager
+    ``all_reduce_host`` on ``group``."""
+    import numpy as np
+
+    local = sum((_leaf_sq(g) for g in jax.tree.leaves(shards)),
+                jnp.float32(0.0))
+    if all_reduce is None:
+        from ..collectives import eager as _eager
+        total = _eager.all_reduce_host(np.float32(local), group=group,
+                                       op="sum")
+    else:
+        total = all_reduce(np.float32(local))
+    return jnp.sqrt(jnp.float32(np.asarray(total)))
+
+
+def sharded_clip_grad_norm(shards, max_norm: float, group=None,
+                           all_reduce=None):
+    """:func:`clip_grad_norm` over per-rank owned shards: ONE scalar
+    all-reduce computes the global norm, then each rank scales only the
+    fragments it owns.  Returns ``(clipped_shards, total_norm)``.
+
+    The scale factor is computed with the exact expression
+    :func:`clip_grad_norm` uses, from a bitwise-identical norm at world 1 —
+    so clipping under ZeRO matches replicated clipping bit-for-bit there,
+    and numerically across worlds."""
+    norm = sharded_global_norm(shards, group=group, all_reduce=all_reduce)
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+    return jax.tree.map(lambda g: (g.astype(jnp.float32) * scale
+                                   ).astype(g.dtype), shards), norm

@@ -11,6 +11,7 @@ from .gspmd import (MOE_EP_RULES, PartitionRules, TRANSFORMER_TP_RULES,
                     make_gspmd_train_step, shard_pytree)
 from .pipeline import PipelineParallel, PipeTrainState
 from .ring_attention import ring_self_attention, ulysses_self_attention
+from .zero import ZeroOptimizer, ZeroParams, ZeroStateError
 
 # torch-style alias (the reference imports nn.parallel.DistributedDataParallel)
 DDP = DistributedDataParallel
@@ -21,4 +22,5 @@ __all__ = ["DistributedDataParallel", "DDP", "TrainState",
            "make_gspmd_train_step", "shard_pytree",
            "PipelineParallel", "PipeTrainState",
            "fsdp_shard", "fsdp_specs",
-           "ring_self_attention", "ulysses_self_attention"]
+           "ring_self_attention", "ulysses_self_attention",
+           "ZeroOptimizer", "ZeroParams", "ZeroStateError"]

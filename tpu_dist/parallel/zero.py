@@ -1,0 +1,466 @@
+"""ZeRO-1/2 on the host collective path: reduce-scatter gradients, shard
+the optimizer update, overlap the parameter all-gather.
+
+The gradient bucketer (tpu_dist/collectives/bucketer.py) lays every bucket
+out **chunk-major**: mid-all-reduce, each rank already materializes exactly
+its ring chunk of every reduced bucket — its ZeRO shard — and then the
+all-gather phase throws that sharding away so every rank can run a fully
+replicated optimizer update over fully replicated optimizer state.
+:class:`ZeroOptimizer` stops at the reduce-scatter phase instead
+(:meth:`Bucketer.reduce_scatter`), keeps optimizer state (Adam m/v, SGD
+momentum, ...) only for the owned chunks — **optimizer-state memory ÷
+world_size** — runs the wrapped update on the flat owned shard (a handful
+of fused elementwise ops instead of per-leaf dispatch over the whole
+tree), and redistributes the updated parameters with an **async** chunk
+all-gather (:func:`~tpu_dist.collectives.ring.ring_chunk_all_gather`) on
+the ordered engine, so the next step's input staging (DeviceLoader
+prefetch) and host work overlap the gather.  This is the classic
+cross-replica weight-update sharding of Xu et al. ("Automatic
+Cross-Replica Sharding of Weight Update in Data-Parallel Training"),
+mirrored from the mesh path's placement-derived ZeRO-3
+(tpu_dist/parallel/fsdp.py) onto the host data plane every CPU-backend,
+chaos/elastic, and store-transport job takes.
+
+**Bitwise story.**  The reduce-scattered shard is bit-identical to the
+span a full all-reduce would have folded there (chunk-major layout: same
+chunk owner ⇒ same accumulation order, same owner-side avg division and
+``comm_dtype`` re-quantization).  Every tpu_dist optimizer update is
+elementwise, so updating the flat shard produces bit-identical parameters
+to the replicated update — at world 1 *and* across worlds (tested); only
+``max_grad_norm`` clipping couples elements, and its sharded form
+(:func:`tpu_dist.optim.sharded_clip_grad_norm`) is bitwise at world 1 and
+numerically equal across worlds.
+
+Usage (the elastic-training loop shape)::
+
+    zopt   = parallel.ZeroOptimizer(optim.Adam(1e-3), group=pg)
+    zstate = zopt.init(params)                    # shards live here
+    handle = None
+    for step in range(start, num_steps):
+        x, y = batch(step)                        # overlaps the gather …
+        if handle is not None:
+            params = handle.wait(timeout=300)     # … waited lazily
+        loss, grads = fwd_bwd(params, x, y)
+        rs = zopt.reduce_scatter(jax.tree.map(np.asarray, grads))
+        loss_now = float(loss)                    # overlaps reduce-scatter
+        handle, zstate = zopt.update(rs, zstate)  # shard update + async AG
+
+``zstate`` is a plain pytree (flat parameter shards + wrapped optimizer
+state + chunk-bounds metadata), checkpointable per rank via
+``resilience.TrainState(..., shard=(rank, world), sharded_keys=("zero",))``.
+Sharded checkpoints are **world-size-pinned**: restoring at a different
+world size raises a named error — elastic resharding (ROADMAP item 1) is
+the follow-up that lifts this.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["ZeroOptimizer", "ZeroParams", "ZeroStateError"]
+
+
+class ZeroStateError(RuntimeError):
+    """A ZeRO optimizer state does not match this run's shard layout
+    (different world size / rank / parameter structure).  Sharded states
+    are world-size-pinned until elastic resharding (ROADMAP item 1)."""
+
+
+class _LeafInfo:
+    __slots__ = ("shape", "dtype", "size", "span")
+
+    def __init__(self, shape, dtype, size, span):
+        self.shape, self.dtype, self.size, self.span = (
+            shape, dtype, size, span)
+
+
+class _Plan:
+    """The static shard layout for one parameter structure at one (rank,
+    world): per-leaf owned spans plus dtype groups — each group is ONE flat
+    shard (the concat of its member leaves' owned chunks, in leaf order),
+    which is also exactly bucket chunk ``rank`` of a chunk-major bucket
+    holding those leaves, so the updated shard drops straight into the
+    ring all-gather buffer."""
+
+    def __init__(self, treedef, leaves: List[_LeafInfo], rank: int,
+                 world: int, groups: List[Tuple[str, List[int]]]):
+        self.treedef = treedef
+        self.leaves = leaves
+        self.rank = rank
+        self.world = world
+        self.groups = groups          # [(group_key, [leaf indices])]
+
+
+class ZeroParams:
+    """Handle for the in-flight parameter all-gather: ``wait(timeout)``
+    returns the full (replicated) parameter tree, re-raising any error the
+    gather hit on the engine (``PeerGoneError``, ...).  Hold it across the
+    next step's input staging so the gather rides under it — that overlap
+    is the ZeRO-2 half of the win."""
+
+    def __init__(self, works: List, assemble, label: str):
+        self.works = list(works)
+        self._assemble = assemble
+        self._label = label
+        self._result = None
+        self._done = False
+
+    def wait(self, timeout: Optional[float] = None):
+        if self._done:
+            return self._result
+        from ..collectives.work import wait_all as _wait_all
+        results = _wait_all(self.works, timeout)
+        self._result = self._assemble(results)
+        self._done = True
+        return self._result
+
+    # BucketWork-flavored aliases so generic handle code is polymorphic
+    wait_all = wait
+
+    def is_completed(self) -> bool:
+        return self._done or all(w.is_completed() for w in self.works)
+
+    def exception(self) -> Optional[BaseException]:
+        for w in self.works:
+            exc = w.exception()
+            if exc is not None:
+                return exc
+        return None
+
+    def __repr__(self):
+        state = "done" if self._done else f"{len(self.works)} gathers"
+        return f"ZeroParams({self._label!r}, {state})"
+
+
+class ZeroOptimizer:
+    """Wrap any :mod:`tpu_dist.optim` optimizer with ZeRO-1/2 sharding
+    over the host collective path.
+
+    Args:
+        opt: the wrapped optimizer (``SGD``/``Adam``/``AdamW``/... — any
+            object with the pure ``init(params)`` / ``update(grads, state,
+            params)`` contract; updates must be elementwise, which every
+            tpu_dist optimizer is).
+        group: process group (default: the default group, resolved per
+            call like the eager collectives).
+        bucket_bytes: wire bucket size for the gradient reduce-scatter
+            (``TPU_DIST_BUCKET_BYTES`` default, as the Bucketer).
+        max_grad_norm: optional global-norm clip applied to the *sharded*
+            gradients (one scalar all-reduce,
+            :func:`tpu_dist.optim.sharded_clip_grad_norm`).
+        reduce_op: "avg" (DDP convention, default) or "sum".
+        dp: pin a specific DataPlane — in-process multi-rank test rigs
+            only, like ``Bucketer(dp=...)`` (ring-only).
+    """
+
+    def __init__(self, opt, group=None, bucket_bytes: Optional[int] = None,
+                 max_grad_norm: Optional[float] = None,
+                 reduce_op: str = "avg", dp=None, comm_dtype=None):
+        from ..collectives.bucketer import Bucketer
+        self.opt = opt
+        self.max_grad_norm = max_grad_norm
+        self.reduce_op = str(reduce_op).lower()
+        self._dp = dp
+        self._bucketer = Bucketer(bucket_bytes=bucket_bytes, dp=dp,
+                                  comm_dtype=comm_dtype)
+        self._group = group
+        self._plan: Optional[_Plan] = None
+        # pinned-mode gather tag counter (same rationale as the Bucketer's)
+        self._seq = 0
+        self._seq_mu = threading.Lock()
+
+    # -- plan ----------------------------------------------------------------
+
+    def _resolve(self, group):
+        from ..collectives import eager as _eager
+        if self._dp is not None:
+            return None, self._dp.num_processes, self._dp.rank
+        group = _eager._default_group(group if group is not None
+                                      else self._group)
+        return group, group.num_processes, group.rank
+
+    def _build_plan(self, params, group) -> _Plan:
+        import jax
+        from ..collectives.ring import _bounds
+        group, n, r = self._resolve(group)
+        leaves, treedef = jax.tree.flatten(params)
+        infos = []
+        for l in leaves:
+            a = np.asarray(l)
+            infos.append(_LeafInfo(a.shape, a.dtype, a.size,
+                                   _bounds(a.size, n)[r] if a.size
+                                   else (0, 0)))
+        # dtype groups in leaf order: one flat shard (and one gather
+        # collective) per dtype keeps ranks' collective sequences identical
+        groups: List[Tuple[str, List[int]]] = []
+        by_key: Dict[str, List[int]] = {}
+        for i, info in enumerate(infos):
+            key = np.dtype(info.dtype).str
+            if key not in by_key:
+                by_key[key] = []
+                groups.append((key, by_key[key]))
+            by_key[key].append(i)
+        return _Plan(treedef, infos, r, n, groups)
+
+    def init(self, params) -> Dict[str, Any]:
+        """Build the ZeRO state for ``params``: this rank's flat parameter
+        shards, wrapped-optimizer state over those shards only, and the
+        chunk-bounds metadata that pins the layout (validated on every
+        update and on checkpoint restore)."""
+        import jax
+        plan = self._plan = self._build_plan(params, None)
+        leaves = [np.ascontiguousarray(np.asarray(l)).reshape(-1)
+                  for l in jax.tree.leaves(params)]
+        shards = {}
+        for key, idxs in plan.groups:
+            frags = [leaves[i][slice(*plan.leaves[i].span)] for i in idxs]
+            shards[key] = (np.concatenate(frags) if frags
+                           else np.zeros(0, np.dtype(key)))
+        meta = {
+            "rank": np.int64(plan.rank),
+            "world": np.int64(plan.world),
+            "span_lo": np.array([i.span[0] for i in plan.leaves], np.int64),
+            "span_hi": np.array([i.span[1] for i in plan.leaves], np.int64),
+            "leaf_size": np.array([i.size for i in plan.leaves], np.int64),
+        }
+        return {"shards": shards, "opt": self.opt.init(shards), "meta": meta}
+
+    def _check_state(self, state, plan: _Plan) -> None:
+        meta = state.get("meta") if isinstance(state, dict) else None
+        if meta is None:
+            raise ZeroStateError(
+                "not a ZeroOptimizer state (no 'meta'): pass the pytree "
+                "returned by ZeroOptimizer.init/update")
+        want = {
+            "rank": plan.rank, "world": plan.world,
+            "span_lo": [i.span[0] for i in plan.leaves],
+            "span_hi": [i.span[1] for i in plan.leaves],
+            "leaf_size": [i.size for i in plan.leaves],
+        }
+        for k, v in want.items():
+            got = np.asarray(meta[k]).tolist() if k in meta else None
+            if got != (v if isinstance(v, list) else int(v)):
+                raise ZeroStateError(
+                    f"ZeRO state layout mismatch on {k!r}: state has {got}, "
+                    f"this run needs {v}.  Sharded optimizer state is "
+                    f"world-size-pinned: it restores only at the same "
+                    f"(rank, world) and parameter structure it was saved "
+                    f"at; resuming at a different world size needs elastic "
+                    f"resharding (ROADMAP item 1).")
+
+    # -- step ----------------------------------------------------------------
+
+    def reduce_scatter(self, grads, group=None):
+        """Issue the bucketed async reduce-scatter of ``grads``; returns
+        the :class:`~tpu_dist.collectives.bucketer.BucketWork` whose
+        ``wait_all()`` yields this rank's owned flat gradient shards.
+        Issue it right after the backward pass and let the loss readback /
+        logging overlap the wire (the PR 5 discipline), then hand it to
+        :meth:`update`."""
+        return self._bucketer.reduce_scatter(grads, op=self.reduce_op,
+                                             group=group)
+
+    def update(self, grads, state, group=None,
+               timeout: Optional[float] = None):
+        """One sharded optimizer step.  ``grads`` is either the full
+        gradient tree (reduce-scattered here) or the handle returned by
+        :meth:`reduce_scatter` (already in flight).  Returns
+        ``(handle, new_state)``: ``handle.wait()`` yields the full updated
+        parameter tree — wait it lazily, after the next step's input
+        staging, so the all-gather runs under that work."""
+        import jax
+        from ..collectives.bucketer import BucketWork
+
+        group, n, r = self._resolve(group)
+        if self._plan is None or self._plan.world != n \
+                or self._plan.rank != r:
+            raise ZeroStateError(
+                "ZeroOptimizer.update before init (or the process group "
+                "changed): call init(params) in this process first")
+        plan = self._plan
+        self._check_state(state, plan)
+
+        if isinstance(grads, (BucketWork, ZeroParams)):
+            frag_tree = grads.wait_all(timeout)
+        else:
+            frag_tree = self.reduce_scatter(grads, group=group) \
+                .wait_all(timeout)
+        frags = jax.tree.leaves(frag_tree)
+        if len(frags) != len(plan.leaves):
+            raise ZeroStateError(
+                f"gradient tree has {len(frags)} leaves, ZeRO plan was "
+                f"built for {len(plan.leaves)}")
+
+        if self.max_grad_norm is not None:
+            from ..optim.clip import sharded_clip_grad_norm
+            frag_tree, _ = sharded_clip_grad_norm(
+                frag_tree, self.max_grad_norm, group=group,
+                all_reduce=self._pinned_scalar_sum())
+            frags = jax.tree.leaves(frag_tree)
+
+        gshards = {}
+        for key, idxs in plan.groups:
+            parts = [np.ascontiguousarray(np.asarray(frags[i]).reshape(-1))
+                     for i in idxs]
+            gshards[key] = (np.concatenate(parts) if parts
+                            else np.zeros(0, np.dtype(key)))
+
+        new_shards, new_opt = self.opt.update(gshards, state["opt"],
+                                              state["shards"])
+        new_shards = {k: np.asarray(v) for k, v in new_shards.items()}
+        handle = self._issue_gather(new_shards, plan, group)
+        return handle, {"shards": new_shards, "opt": new_opt,
+                        "meta": state["meta"]}
+
+    def _pinned_scalar_sum(self):
+        """In pinned (in-process test-rig) mode the clip's scalar
+        all-reduce must ride this instance's plane, not the process-global
+        eager path — production (dp=None) uses the eager default."""
+        if self._dp is None:
+            return None
+        dp = self._dp
+
+        def _sum(v):
+            from ..collectives.ring import ring_all_reduce
+            return ring_all_reduce(dp, v, op="sum", tag="zero_clip")
+        return _sum
+
+    # -- parameter all-gather -------------------------------------------------
+
+    def _issue_gather(self, new_shards: Dict[str, np.ndarray], plan: _Plan,
+                      group) -> ZeroParams:
+        """Submit one async chunk all-gather per dtype group; the handle
+        assembles the full parameter tree on wait.  The gather buffer is
+        chunk-major (chunk *c* = concat of member leaves' chunk *c*), so
+        this rank's updated shard IS bucket chunk ``rank`` — it drops in
+        without reshuffling, and unpacking inverts the layout."""
+        from ..collectives import eager as _eager
+        from ..collectives.ring import _bounds
+        from ..collectives.work import completed_work, engine_for
+
+        n, r = plan.world, plan.rank
+        pinned = self._dp is not None
+        engine = engine_for(self._dp)
+        issue_seq = self._next_issue_seq() if pinned else -1
+        use_ring = n > 1 and (pinned or (_eager._dp_enabled()
+                                         and not _eager._prefer_mesh(group)
+                                         and _eager._coll_store()
+                                         is not None))
+
+        works, plans = [], []
+        for gi, (key, idxs) in enumerate(plan.groups):
+            shard = new_shards[key]
+            # updated dtype may differ from the param dtype (mixed-precision
+            # promotion inside the wrapped optimizer) — every rank promotes
+            # identically, so the layout stays rank-consistent
+            dt = shard.dtype
+            leaf_bounds = [_bounds(plan.leaves[i].size, n) for i in idxs]
+            total = sum(plan.leaves[i].size for i in idxs)
+            bucket_bounds = []
+            pos = 0
+            for c in range(n):
+                lo = pos
+                pos += sum(b[c][1] - b[c][0] for b in leaf_bounds)
+                bucket_bounds.append((lo, pos))
+            if n <= 1:
+                works.append(completed_work(shard.copy(), "zero_gather"))
+            elif use_ring and self._ring_ok(dt):
+                buf = np.empty(total, dtype=dt)
+                lo, hi = bucket_bounds[r]
+                buf[lo:hi] = shard
+                works.append(engine.submit(
+                    self._gather_body(buf, bucket_bounds, group, issue_seq,
+                                      gi),
+                    label=f"zero_gather/g{gi}"))
+            else:
+                works.append(engine.submit(
+                    self._gather_body_store(shard, group),
+                    label=f"zero_gather/g{gi}/store"))
+            plans.append((idxs, leaf_bounds, total))
+
+        def assemble(results):
+            leaves_out: List = [None] * len(plan.leaves)
+            for (idxs, leaf_bounds, total), buf in zip(plans, results):
+                outs = [np.empty(plan.leaves[i].size, dtype=buf.dtype)
+                        for i in idxs]
+                pos = 0
+                for c in range(n):
+                    for out, b in zip(outs, leaf_bounds):
+                        flo, fhi = b[c]
+                        if fhi > flo:
+                            out[flo:fhi] = buf[pos:pos + (fhi - flo)]
+                            pos += fhi - flo
+                for i, out in zip(idxs, outs):
+                    leaves_out[i] = out.reshape(plan.leaves[i].shape)
+            import jax
+            return jax.tree.unflatten(plan.treedef, leaves_out)
+
+        return ZeroParams(works, assemble, f"zero_params x{len(works)}")
+
+    @staticmethod
+    def _ring_ok(dt: np.dtype) -> bool:
+        """Can the wire carry this dtype raw?  (The gather only moves
+        bytes — no reduce-op constraint.)"""
+        if dt.kind in "iufb":
+            return True
+        if dt.kind == "V" and dt.fields is None:
+            from ..collectives.transport import _decode_dtype
+            try:
+                return _decode_dtype(dt.name) == dt
+            except Exception:
+                return False
+        return False
+
+    def _next_issue_seq(self) -> int:
+        with self._seq_mu:
+            s = self._seq
+            self._seq += 1
+            return s
+
+    def _gather_body(self, buf, bucket_bounds, group, issue_seq: int,
+                     gi: int):
+        """Deferred per-group ring chunk all-gather; runs on the ordered
+        engine, so its obs span carries ``queue_ns`` — the time the gather
+        sat behind earlier collectives — and the overlap with the next
+        step's staging is visible in the trace."""
+
+        def body():
+            import time as _time
+            from ..collectives import eager as _eager
+            from ..collectives import ring as _ring
+            if self._dp is not None:
+                dp = self._dp
+                tag = f"zag/i{issue_seq}/{gi}"
+            else:
+                store = _eager._coll_store()
+                seq = _eager._next_seq("zero_ag", 0)
+                tag = f"{_eager._ns()}/coll/zag/{seq}"
+                _eager._sanitize("zero_param_gather", group, store,
+                                 value=buf)
+                dp = _eager._maybe_data_plane(group, store)
+            with _eager._obs_span("zero_param_gather", value=buf):
+                t0 = _time.perf_counter()
+                out = _ring.ring_chunk_all_gather(dp, buf, bucket_bounds,
+                                                  tag=tag)
+                _eager._record("zero_param_gather", "dataplane",
+                               buf.nbytes, t0)
+            return out
+
+        return body
+
+    def _gather_body_store(self, shard, group):
+        """Store-transport fallback (exotic dtypes / forced store mode):
+        object-gather every rank's shard — chunk-major means chunk *c* IS
+        rank *c*'s shard, so the full buffer is just the rank-ordered
+        concat."""
+
+        def body():
+            from ..collectives import eager as _eager
+            with _eager._obs_span("zero_param_gather", value=shard):
+                rows = _eager.all_gather_object(shard, group=group)
+            return np.concatenate([np.asarray(x).reshape(-1) for x in rows])
+
+        return body

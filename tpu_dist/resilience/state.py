@@ -23,7 +23,7 @@ uninterrupted run as long as the data pipeline is keyed on ``step``
 from __future__ import annotations
 
 import os
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Sequence, Tuple
 
 from . import chaos as _chaos
 from .heartbeat import Heartbeat, HeartbeatMonitor, RankLostError
@@ -51,6 +51,19 @@ class TrainState:
             (None): enabled on rank 0 when the launcher exported
             ``TPU_DIST_HEARTBEAT_TIMEOUT`` (``--heartbeat_timeout``).
         metadata: extra dict stored in every checkpoint's ``tree.json``.
+        shard: ``(rank, world)`` of this process — required with
+            ``sharded_keys``.
+        sharded_keys: top-level keys of the state dict that hold
+            **rank-sharded** state (ZeRO optimizer shards,
+            tpu_dist/parallel/zero.py).  Those subtrees differ per rank by
+            design: each rank checkpoints its own copy under
+            ``checkpoint.shard_root(root, rank)`` while the rest of the
+            state stays in the shared replicated checkpoint; ``resume``
+            restores both at one agreed step (all ranks settle on the
+            newest step every rank has complete, via the control-plane
+            store when one is reachable).  Sharded checkpoints are
+            world-size-pinned — restoring at a different world size raises
+            a named error until elastic resharding (ROADMAP item 1).
     """
 
     def __init__(self, root: str, save_every: int = 100,
@@ -58,13 +71,19 @@ class TrainState:
                  heartbeat: bool = True,
                  heartbeat_interval: float = 1.0,
                  monitor: Optional[bool] = None,
-                 metadata: Optional[Dict] = None):
+                 metadata: Optional[Dict] = None,
+                 shard: Optional[Tuple[int, int]] = None,
+                 sharded_keys: Sequence[str] = ()):
         _chaos.install_from_env()
         self.root = root
         self.save_every = save_every
         self.keep = keep
         self.verify = verify
         self.metadata = metadata
+        self.shard = (int(shard[0]), int(shard[1])) if shard else None
+        self.sharded_keys = tuple(sharded_keys)
+        if self.sharded_keys and self.shard is None:
+            raise ValueError("sharded_keys needs shard=(rank, world)")
         self._hb: Optional[Heartbeat] = None
         self._monitor: Optional[HeartbeatMonitor] = None
         self._monitor_store = None  # dedicated client; closed in close()
@@ -113,22 +132,103 @@ class TrainState:
     def resume(self, state: Any) -> Tuple[Any, int]:
         """``(state, start_step)``: restore the latest checkpoint if one
         exists (returning its step + 1), else pass ``state`` through with
-        start 0."""
+        start 0.  With ``sharded_keys``, the replicated and this rank's
+        sharded subtrees are restored at one step every rank can serve
+        (agreed through the control-plane store when reachable)."""
         from .. import checkpoint
-        last = checkpoint.latest_step(self.root)
-        if last is None:
-            return state, 0
-        restored = checkpoint.restore(self.root, state, step=last,
-                                      verify=self.verify)
         from ..dist.rendezvous import generation
         from ..utils.logging import log_event
-        log_event("auto-resume", step=last, generation=generation())
+        if not self.sharded_keys:
+            last = checkpoint.latest_step(self.root)
+            if last is None:
+                return state, 0
+            restored = checkpoint.restore(self.root, state, step=last,
+                                          verify=self.verify)
+            log_event("auto-resume", step=last, generation=generation())
+            return restored, last + 1
+
+        if not isinstance(state, dict):
+            raise TypeError("sharded_keys needs a dict state at top level")
+        rank, world = self.shard
+        sroot = checkpoint.shard_root(self.root, rank)
+        # newest step this rank has COMPLETE (replicated + its own shard):
+        # a kill between the two writes must not leave a half-resumable step
+        common = (set(checkpoint.all_steps(self.root))
+                  & set(checkpoint.all_steps(sroot)))
+        last = self._agree_resume_step(common)
+        if last < 0:
+            return state, 0
+        repl_tmpl = {k: v for k, v in state.items()
+                     if k not in self.sharded_keys}
+        shard_tmpl = {k: state[k] for k in self.sharded_keys}
+        restored = dict(checkpoint.restore(self.root, repl_tmpl, step=last,
+                                           verify=self.verify))
+        restored.update(checkpoint.restore(self.root, shard_tmpl, step=last,
+                                           verify=self.verify,
+                                           shard=self.shard))
+        log_event("auto-resume", step=last, generation=generation(),
+                  shard=f"r{rank}/w{world}")
         return restored, last + 1
+
+    def _agree_resume_step(self, steps) -> int:
+        """All ranks settle on the newest step EVERY rank has complete —
+        max of the intersection of the per-rank complete-step sets (not
+        min of per-rank maxes: keep-N pruning means a peer's older step
+        may no longer exist here, and a mid-save kill means this rank's
+        newest may not exist there).  Rides the control-plane store; when
+        none is configured (single-rank jobs, storeless rigs) the local
+        newest stands.  Once the store IS reachable, a peer failing to
+        report within the deadline raises — ranks resuming at different
+        steps would diverge the gang silently, which is strictly worse
+        than a loud restart."""
+        steps = set(steps)
+        local = max(steps) if steps else -1
+        rank, world = self.shard
+        if world <= 1:
+            return local
+        from .heartbeat import _store_from_env
+        try:
+            store = _store_from_env()
+        except Exception as e:
+            store = None
+            from ..utils.logging import log_event
+            log_event("zero-resume-agreement-skipped", error=repr(e),
+                      candidate=local)
+        if store is None:
+            return local
+        try:
+            from ..dist.rendezvous import generation
+            base = f"tpu_dist/g{generation()}/zero/resume"
+            store.set(f"{base}/{rank}",
+                      ",".join(str(s) for s in sorted(steps)).encode())
+            peers = [r for r in range(world) if r != rank]
+            store.wait([f"{base}/{r}" for r in peers], timeout=60.0)
+            agreed = steps
+            for r in peers:
+                raw = store.get(f"{base}/{r}").decode()
+                agreed &= {int(s) for s in raw.split(",") if s}
+            return max(agreed) if agreed else -1
+        finally:
+            try:
+                store.close()
+            except Exception:
+                pass
 
     def save(self, state: Any, step: int) -> str:
         from .. import checkpoint
-        return checkpoint.save(self.root, state, step,
+        if not self.sharded_keys:
+            return checkpoint.save(self.root, state, step,
+                                   metadata=self.metadata, keep=self.keep)
+        if not isinstance(state, dict):
+            raise TypeError("sharded_keys needs a dict state at top level")
+        repl = {k: v for k, v in state.items()
+                if k not in self.sharded_keys}
+        shardpart = {k: state[k] for k in self.sharded_keys}
+        path = checkpoint.save(self.root, repl, step,
                                metadata=self.metadata, keep=self.keep)
+        checkpoint.save(self.root, shardpart, step, metadata=self.metadata,
+                        keep=self.keep, shard=self.shard)
+        return path
 
     def end_step(self, state: Any, step: int) -> None:
         """Call at the end of every optimizer step: publish progress, save
