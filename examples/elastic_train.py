@@ -19,6 +19,23 @@ relaunch, and resume from the latest checkpoint with an identical loss
 trajectory (batches are keyed on the global step).  See docs/resilience.md
 for the failure model.
 
+With ``--elastic_world=MIN:MAX`` the world size itself is elastic: a rank
+that is preempted *for good* makes the supervisor re-form the gang at the
+surviving rank count (instead of burning restarts waiting for the dead),
+resharding the checkpoints to the new world on resume.  Simulate the full
+shrink/grow cycle deterministically::
+
+    TPU_DIST_CHAOS="shrink:rank=1,step=20;grow:rank=0,step=35,world=2" \\
+        python -m tpu_dist.launch --nproc_per_node=2 --master_port=0 \\
+        --elastic_world=1:2 --heartbeat_timeout=30 \\
+        examples/elastic_train.py --backend cpu --synthetic --zero \\
+        --max-steps 50 --exit-on-preempt
+
+``--exit-on-preempt`` is the production half of the same protocol: on
+SIGTERM (the cloud preemption notice) the loop saves at the next step
+boundary and exits ``PREEMPTED_EXIT_CODE`` so the supervisor shrinks
+instead of retrying a world that can never fill.
+
 Gradient averaging uses the bucketed ASYNC host collectives
 (:class:`tpu_dist.collectives.Bucketer`): gradient leaves coalesce into
 flat buckets issued as asynchronous ring all-reduces over the p2p data
@@ -33,8 +50,9 @@ at the reduce-scatter phase, each rank keeps optimizer state only for the
 chunks it owns (state memory / world), and the updated parameters come
 back through an async all-gather waited lazily — the next step's batch
 assembly runs under the wire.  Checkpoints then store each rank's
-optimizer shard separately (world-size-pinned: resume at the same
-``--nproc_per_node``).
+optimizer shard separately — world-size-portable: a run checkpointed at
+one ``--nproc_per_node`` resumes at another through elastic resharding
+(docs/resilience.md).
 """
 
 import argparse
@@ -58,6 +76,12 @@ def main():
                         help="ZeRO-1/2: reduce-scatter grads, shard the "
                              "optimizer state/update, overlap the param "
                              "all-gather")
+    parser.add_argument("--exit-on-preempt", action="store_true",
+                        help="on SIGTERM (cloud preemption notice): save "
+                             "at the next step boundary and exit "
+                             "PREEMPTED_EXIT_CODE (117) so a supervisor "
+                             "running --elastic_world re-forms the gang "
+                             "at the surviving rank count")
     args = parser.parse_args()
 
     if args.backend == "cpu":
@@ -108,6 +132,26 @@ def main():
     log = MetricLogger(every=25, fmt="[elastic] step {step} loss {loss:.4f}")
     params0 = model.init(jax.random.PRNGKey(0))
 
+    from tpu_dist import checkpoint as ckpt
+    stop = ckpt.GracefulShutdown().__enter__() if args.exit_on_preempt \
+        else None   # entered for the process lifetime
+
+    def preempted(ts, state, step):
+        """SIGTERM arrived: save NOW (the cadence save may be steps away)
+        and exit the elastic-shrink protocol code so the supervisor
+        re-forms without this rank instead of burning restarts.  The exit
+        must be `os._exit` — a normal sys.exit runs the jax coordination
+        service's atexit teardown, which blocks on the still-running
+        peers and deadlocks the gang; the checkpoint is already fsync'd
+        and the supervisor only needs the exit code."""
+        if stop is None or not stop.requested:
+            return False
+        ts.save(state, step)
+        print(f"[elastic] rank preempted at step {step}; exiting "
+              f"{resilience.PREEMPTED_EXIT_CODE} for an elastic shrink",
+              flush=True)
+        os._exit(resilience.PREEMPTED_EXIT_CODE)
+
     if args.zero:
         from tpu_dist.parallel import ZeroOptimizer
         zopt = ZeroOptimizer(opt, group=pg)
@@ -134,6 +178,9 @@ def main():
                 if args.save_every and step % args.save_every == 0:
                     params = handle.wait(timeout=300)  # checkpoint needs it
                 ts.end_step({"params": params, "zero": zstate}, step)
+                if stop is not None and stop.requested:
+                    params = handle.wait(timeout=300)
+                    preempted(ts, {"params": params, "zero": zstate}, step)
             params = handle.wait(timeout=300) if handle is not None \
                 else params
         rank_zero_print(f"[elastic] done at step {args.max_steps}")
@@ -162,6 +209,7 @@ def main():
             params, opt_state = opt.update(g, opt_state, params)
             log.push(step=step, loss=loss_now)
             ts.end_step({"params": params, "opt": opt_state}, step)
+            preempted(ts, {"params": params, "opt": opt_state}, step)
     rank_zero_print(f"[elastic] done at step {args.max_steps}")
     dist.destroy_process_group()
 

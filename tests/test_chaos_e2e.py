@@ -6,8 +6,10 @@ tier-1 (``chaos`` marker, NOT ``slow``): the elastic layer must be proven on
 every PR, not only in the nightly slow tier.
 """
 
+import glob
 import json
 import os
+import shutil
 import subprocess
 import sys
 import textwrap
@@ -94,7 +96,8 @@ _TRAIN_WORKER = textwrap.dedent("""
 
 
 def _launch_train(tmp_path, tag, chaos=None, max_restarts=0, n_steps=10,
-                  timeout=420, worker_src=None):
+                  timeout=420, worker_src=None, nproc=2, extra_args=(),
+                  extra_env=None, ckpt_root=None):
     out_dir = tmp_path / tag
     out_dir.mkdir()
     script = tmp_path / f"train_worker_{tag}.py"
@@ -113,11 +116,15 @@ def _launch_train(tmp_path, tag, chaos=None, max_restarts=0, n_steps=10,
         env["TPU_DIST_CHAOS"] = chaos
     else:
         env.pop("TPU_DIST_CHAOS", None)
+    env.update(extra_env or {})
     r = subprocess.run(
-        [sys.executable, "-m", "tpu_dist.launch", "--nproc_per_node=2",
+        [sys.executable, "-m", "tpu_dist.launch",
+         f"--nproc_per_node={nproc}",
          "--master_port=0", f"--max_restarts={max_restarts}",
          "--restart_backoff=0.1", "--heartbeat_timeout=3",
-         str(script), str(out_dir), str(out_dir / "ckpt"), str(n_steps)],
+         *extra_args,
+         str(script), str(out_dir), str(ckpt_root or (out_dir / "ckpt")),
+         str(n_steps)],
         cwd=_REPO, env=env, capture_output=True, text=True, timeout=timeout)
     return r, out_dir
 
@@ -211,12 +218,15 @@ _ZERO_TRAIN_WORKER = textwrap.dedent("""
         return jax.value_and_grad(loss)(params)
 
     losses = {}
-    with resilience.TrainState(ckpt_root, save_every=5, keep=None,
+    save_every = int(os.environ.get("E2E_SAVE_EVERY", "5"))
+    with resilience.TrainState(ckpt_root, save_every=save_every, keep=None,
                                shard=(rank, nproc),
                                sharded_keys=("zero",)) as ts:
         state, start = ts.resume({"params": params0,
                                   "zero": zopt.init(params0)})
         params, zstate = state["params"], state["zero"]
+        gen_losses = os.path.join(
+            out_dir, f"losses_g{dist.generation()}_r{rank}.json")
         for step in range(start, n_steps):
             x, y = batch(step, rank)
             l, g = fwd_bwd(params, x, y)
@@ -225,6 +235,11 @@ _ZERO_TRAIN_WORKER = textwrap.dedent("""
             handle, zstate = zopt.update(rs, zstate, group=pg)
             params = handle.wait(timeout=300)
             losses[step] = loss_now
+            # per-generation trajectory, flushed every step: an incarnation
+            # a chaos fault kills mid-run still leaves its losses behind
+            # (the elastic e2e compares each destination-world phase)
+            with open(gen_losses, "w") as f:
+                json.dump({str(k): v for k, v in losses.items()}, f)
             ts.end_step({"params": params, "zero": zstate}, step)
 
     leaves = [np.asarray(a, np.float32).ravel()
@@ -264,6 +279,94 @@ def test_zero_kill_restart_resume_bitwise(tmp_path):
             assert fa[rank]["losses"][str(step)] == \
                 fb[rank]["losses"][str(step)], f"step {step} diverged"
     digests = {f["params_sha256"] for f in (*fa.values(), *fb.values())}
+    assert len(digests) == 1, f"parameter divergence: {digests}"
+
+
+def _trim_ckpt_tree(root: str, max_step: int) -> None:
+    """Roll a checkpoint-tree copy back to ``max_step`` (replicated root +
+    every shard root) — reconstructs the on-disk state an earlier
+    incarnation resumed from."""
+    roots = [root] + sorted(glob.glob(os.path.join(root, "shard_r*")))
+    for r in roots:
+        for d in glob.glob(os.path.join(r, "step_*")):
+            if int(os.path.basename(d).split("_")[1]) > max_step:
+                shutil.rmtree(d)
+
+
+def _gen_losses(out_dir, gen, rank):
+    with open(out_dir / f"losses_g{gen}_r{rank}.json") as f:
+        return json.load(f)
+
+
+@pytest.mark.zero
+@pytest.mark.elastic
+def test_elastic_shrink_grow_4_2_4_bitwise(tmp_path):
+    """ISSUE 7 acceptance: a world-4 ZeRO run is preempted down to world 2
+    (two ranks exit PREEMPTED at step 5), re-forms and resumes by
+    resharding the world-4 step-4 checkpoint, then grows back to world 4
+    at step 8 and reshards the world-2 step-8 checkpoint — all without
+    touching the --max_restarts budget.  Each destination-world phase must
+    be BITWISE equal to an uninterrupted run at that world size resumed
+    from the same checkpoint tree (elementwise optimizer × bitwise
+    fragments), and the final parameters of the regrown world must match
+    the uninterrupted world-4 continuation exactly."""
+    chaos = ("shrink:rank=2,step=5;shrink:rank=3,step=5;"
+             "grow:rank=0,step=8,world=4")
+    ra, dir_a = _launch_train(
+        tmp_path, "elastic", chaos=chaos, max_restarts=0, n_steps=12,
+        worker_src=_ZERO_TRAIN_WORKER, nproc=4,
+        extra_args=("--elastic_world=2:4",),
+        extra_env={"E2E_SAVE_EVERY": "2", "TPU_DIST_PREEMPT_SETTLE": "3"},
+        timeout=600)
+    assert ra.returncode == 0, f"stdout:\n{ra.stdout}\nstderr:\n{ra.stderr}"
+    # both world changes rode OUTSIDE the restart budget (max_restarts=0!)
+    assert "elastic world change: 4 -> 2" in ra.stderr, ra.stderr
+    assert "elastic world change: 2 -> 4" in ra.stderr, ra.stderr
+    assert "restart budget untouched" in ra.stderr
+    assert "relaunching" not in ra.stderr   # no failure restart happened
+    # the supervisor printed each transition's resharding plan summary
+    assert "reshard plan: world 4 -> 2" in ra.stderr, ra.stderr
+    assert "reshard plan: world 2 -> 4" in ra.stderr, ra.stderr
+    assert "new rank 1:" in ra.stderr
+    fa = _finals(dir_a, nproc=4)
+    for rank in range(4):
+        assert fa[rank]["generation"] == 2, fa[rank]
+        assert fa[rank]["start"] == 9, fa[rank]   # resharded from step 8
+
+    # --- uninterrupted world-2 run resumed from the same world-4 step-4
+    # tree: run A's shrunken phase must match it bitwise
+    ckpt_b = tmp_path / "ckpt_fixed2"
+    shutil.copytree(dir_a / "ckpt", ckpt_b)
+    _trim_ckpt_tree(str(ckpt_b), 4)
+    rb, dir_b = _launch_train(
+        tmp_path, "fixed2", n_steps=12, worker_src=_ZERO_TRAIN_WORKER,
+        nproc=2, ckpt_root=ckpt_b, extra_env={"E2E_SAVE_EVERY": "2"})
+    assert rb.returncode == 0, f"stdout:\n{rb.stdout}\nstderr:\n{rb.stderr}"
+    fb = _finals(dir_b, nproc=2)
+    for rank in range(2):
+        assert fb[rank]["start"] == 5, fb[rank]   # resharded 4->2 resume
+        la, lb = _gen_losses(dir_a, 1, rank), _gen_losses(dir_b, 0, rank)
+        for step in range(5, 9):
+            assert la[str(step)] == lb[str(step)], \
+                f"world-2 phase diverged at step {step} rank {rank}"
+
+    # --- uninterrupted world-4 run resumed from the same world-2 step-8
+    # tree: run A's regrown phase must match it bitwise, params included
+    ckpt_c = tmp_path / "ckpt_fixed4"
+    shutil.copytree(dir_a / "ckpt", ckpt_c)
+    _trim_ckpt_tree(str(ckpt_c), 8)
+    rc, dir_c = _launch_train(
+        tmp_path, "fixed4", n_steps=12, worker_src=_ZERO_TRAIN_WORKER,
+        nproc=4, ckpt_root=ckpt_c, extra_env={"E2E_SAVE_EVERY": "2"})
+    assert rc.returncode == 0, f"stdout:\n{rc.stdout}\nstderr:\n{rc.stderr}"
+    fc = _finals(dir_c, nproc=4)
+    for rank in range(4):
+        assert fc[rank]["start"] == 9, fc[rank]   # resharded 2->4 resume
+        for step in range(9, 12):
+            assert fa[rank]["losses"][str(step)] == \
+                fc[rank]["losses"][str(step)], \
+                f"world-4 phase diverged at step {step} rank {rank}"
+    digests = {f["params_sha256"] for f in (*fa.values(), *fc.values())}
     assert len(digests) == 1, f"parameter divergence: {digests}"
 
 

@@ -80,6 +80,54 @@ class TestDistributedSamplerProperties:
         with pytest.raises(ValueError, match="rank"):
             DistributedSampler(_Sized(10), num_replicas=4, rank=4)
 
+
+class TestSetWorld:
+    """Elastic re-shard (ISSUE 7 satellite): after the gang re-forms at a
+    different world size, set_world must redistribute samples over the new
+    partition exactly as a freshly-constructed sampler would — epoch
+    determinism included, since the permutation is seeded by (seed, epoch)
+    only, never by the world."""
+
+    @pytest.mark.parametrize("n_old,n_new", [(4, 2), (2, 4), (3, 1)])
+    def test_matches_fresh_sampler_at_new_world(self, n_old, n_new):
+        ds = _Sized(101)
+        for r in range(n_new):
+            s = DistributedSampler(ds, n_old, min(r, n_old - 1),
+                                   shuffle=True, seed=9)
+            s.set_epoch(3)
+            s.set_world(r, n_new)
+            fresh = DistributedSampler(ds, n_new, r, shuffle=True, seed=9)
+            fresh.set_epoch(3)
+            assert list(s) == list(fresh)
+            assert len(s) == len(fresh)
+
+    def test_new_world_covers_same_sample_set(self):
+        ds = _Sized(100)
+        old = [DistributedSampler(ds, 4, r, shuffle=True, seed=5)
+               for r in range(4)]
+        for s in old:
+            s.set_epoch(2)
+        covered_old = sorted(i for s in old for i in s)
+        # shrink: ranks 0 and 1 survive and re-shard to world 2
+        for r, s in enumerate(old[:2]):
+            s.set_world(r, 2)
+        covered_new = sorted(i for s in old[:2] for i in s)
+        assert covered_new == covered_old   # same epoch, same sample set
+
+    def test_epoch_determinism_preserved_across_reshard(self):
+        ds = _Sized(64)
+        s = DistributedSampler(ds, 4, 1, shuffle=True, seed=11)
+        s.set_epoch(7)
+        before = list(s)
+        s.set_world(1, 2)     # shrink ...
+        s.set_world(1, 4)     # ... and grow back
+        assert list(s) == before
+
+    def test_bad_new_rank_raises(self):
+        s = DistributedSampler(_Sized(10), 4, 0)
+        with pytest.raises(ValueError, match="rank"):
+            s.set_world(2, 2)
+
     def test_defaults_from_group(self):
         import tpu_dist.dist as dist
         if dist.is_initialized():

@@ -42,9 +42,17 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 __all__ = ["save", "restore", "latest_step", "all_steps", "shard_root",
-           "AsyncCheckpointer", "GracefulShutdown"]
+           "prune_sharded", "DigestError", "AsyncCheckpointer",
+           "GracefulShutdown"]
 
 _STEP_DIR = re.compile(r"^step_(\d{8})$")
+
+
+class DigestError(ValueError):
+    """A checkpoint (or a single shard fragment, on the elastic reshard
+    path) failed sha256 verification against the digest recorded at save
+    time: truncated, bit-rotted, or tampered — refusing to load is always
+    better than resuming divergent."""
 
 
 def _flatten(tree):
@@ -105,7 +113,14 @@ def save(root: str, tree: Any, step: int, metadata: Optional[Dict] = None,
     optimizer shards, tpu_dist/parallel/zero.py): EVERY rank writes its own
     tree — which differs per rank by design — under
     :func:`shard_root`, with the shard coordinates recorded in the
-    metadata so :func:`restore` can refuse a world-size mismatch loudly.
+    metadata.  When the tree carries ZeRO layout meta (leaf sizes +
+    dtypes), a **reshard manifest** is embedded too — which saved arrays
+    are sharded along the group axis, per-fragment sha256 digests — so a
+    later restore at a *different* world size is self-describing and
+    digest-verified per fragment (tpu_dist/resilience/reshard.py).
+    :func:`restore` itself still refuses a shard-coordinate mismatch;
+    elastic restores go through ``resilience.TrainState.resume`` or
+    ``reshard.reshard_restore``.
     """
     import jax
 
@@ -116,6 +131,23 @@ def save(root: str, tree: Any, step: int, metadata: Optional[Dict] = None,
         meta = dict(metadata or {})
         meta["shard_rank"], meta["shard_world"] = rank, world
         arrays = {k: _materialize(v) for k, v in _flatten(tree).items()}
+        try:
+            from .resilience.reshard import manifest_from_arrays
+            manifest = manifest_from_arrays(arrays)
+        except Exception as e:
+            # manifest is additive; never fail the save — but a silent
+            # omission leaves a world-size-pinned checkpoint that only
+            # surfaces when the old-world gang is already gone, so make
+            # the loss of portability visible while it is still fixable
+            manifest = None
+            try:
+                from .utils.logging import log_event
+                log_event("reshard-manifest-failed", step=step,
+                          shard=f"r{rank}/w{world}", error=repr(e))
+            except Exception:
+                pass
+        if manifest is not None:
+            meta["reshard"] = manifest
         _write(sroot, path, arrays, step, meta, keep)
         return path
 
@@ -302,6 +334,56 @@ def latest_step(root: str) -> Optional[int]:
     return steps[-1] if steps else None
 
 
+def prune_sharded(root: str, keep: int) -> list:
+    """Prune a sharded checkpoint *tree* (replicated root + every
+    ``shard_r*`` root) to the newest ``keep`` **complete** steps; returns
+    the pruned step numbers.
+
+    Per-root ``keep=`` pruning is wrong for sharded trees: each root prunes
+    on its own cadence, so under skew (one rank saving behind the others,
+    or a mid-save kill) a root can delete the one older step that is still
+    complete *everywhere* — exactly the step the intersection-based resume
+    agreement would pick — leaving the gang nothing to resume from.  This
+    prunes on the **tree** invariant instead: a step is deletable only
+    when at least ``keep`` newer steps are complete — replicated checkpoint
+    present and, at the world each step's own shard metadata records, every
+    shard 0..world-1 present (:func:`~tpu_dist.resilience.reshard.resumable_steps`,
+    so mixed-world trees left behind by elastic shrink/grow prune
+    correctly too).  Incomplete steps newer than the cutoff are left for
+    their writers to finish; any step older than the cutoff goes,
+    complete or not.
+
+    Safe to call from every rank (deletions are idempotent; a racing rank
+    that still sees an in-flight step as incomplete merely prunes less).
+
+    Assumes the shared checkpoint root :class:`~tpu_dist.resilience.TrainState`
+    documents (every shard root visible on this filesystem).  On a rig
+    with per-host private disks the local view can never prove a step
+    complete, so this deliberately prunes NOTHING there (safe, but the
+    operator must prune externally) — deleting on a partial view could
+    destroy the one step the gang's resume agreement needs.
+    """
+    from .resilience.reshard import local_visibility, resumable_steps
+    complete = sorted(resumable_steps([local_visibility(root)]))
+    if keep is None or len(complete) <= max(int(keep), 0):
+        return []
+    cutoff = complete[-int(keep)]
+    roots = [root]
+    if os.path.isdir(root):
+        roots += [os.path.join(root, name)
+                  for name in sorted(os.listdir(root))
+                  if name.startswith("shard_r")
+                  and os.path.isdir(os.path.join(root, name))]
+    pruned = set()
+    for r in roots:
+        for s in all_steps(r):
+            if s < cutoff:
+                shutil.rmtree(os.path.join(r, f"step_{s:08d}"),
+                              ignore_errors=True)
+                pruned.add(s)
+    return sorted(pruned)
+
+
 def restore(root: str, template: Any, step: Optional[int] = None,
             sharding=None, verify: bool = False,
             shard: Optional[tuple] = None) -> Any:
@@ -317,8 +399,9 @@ def restore(root: str, template: Any, step: Optional[int] = None,
 
     ``shard=(rank, world)`` loads this rank's rank-sharded state (see
     :func:`save`): the recorded shard coordinates must match exactly —
-    sharded checkpoints are world-size-pinned until elastic resharding
-    (ROADMAP item 1) can redistribute them.
+    direct restore is the fast same-world path; a checkpoint saved at a
+    different world size resumes through elastic resharding
+    (``resilience.TrainState.resume`` / ``resilience.reshard``).
 
     Raises with a precise message when the tree structure or a leaf
     shape/dtype does not match the template — resuming into a changed model
@@ -343,9 +426,10 @@ def restore(root: str, template: Any, step: Optional[int] = None,
             raise ValueError(
                 f"sharded checkpoint at {path!r} was saved as rank "
                 f"{got[0]} of world {got[1]}, but this process is rank "
-                f"{rank} of world {world}.  Sharded optimizer state is "
-                f"world-size-pinned; resuming at a different world size "
-                f"needs elastic resharding (ROADMAP item 1).")
+                f"{rank} of world {world}.  Direct restore is exact-match "
+                f"only; to resume at a different world size use elastic "
+                f"resharding (resilience.TrainState.resume, or "
+                f"resilience.reshard.reshard_restore).")
     npz_path = os.path.join(path, "arrays.npz")
     if verify:
         recorded = meta.get("arrays_sha256")
@@ -355,7 +439,7 @@ def restore(root: str, template: Any, step: Optional[int] = None,
                 f"by an older tpu_dist); re-save it or pass verify=False")
         actual = _sha256_file(npz_path)
         if actual != recorded:
-            raise ValueError(
+            raise DigestError(
                 f"checkpoint at {path!r} failed digest verification "
                 f"(recorded sha256 {recorded[:12]}…, actual {actual[:12]}…) "
                 f"— truncated or corrupted; refusing to load")

@@ -191,26 +191,43 @@ class DistributedSampler(Sampler):
                                 if dist.is_initialized() else 1)
             if rank is None:
                 rank = dist.get_rank() if dist.is_initialized() else 0
-        if not 0 <= rank < num_replicas:
-            raise ValueError(
-                f"rank must be in [0, {num_replicas}), got rank={rank}")
         self.dataset = dataset
-        self.num_replicas = num_replicas
-        self.rank = rank
         self.shuffle = shuffle
         self.seed = seed
         self.drop_last = drop_last
         self.epoch = 0
-        n = len(dataset)
+        self.set_world(rank, num_replicas)
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def set_world(self, rank: int, num_replicas: int) -> None:
+        """Re-shard for a changed process world — the data-pipeline half of
+        an elastic restart (``--elastic_world``): after the gang re-forms
+        at a different rank count, every sampler must redistribute samples
+        over the NEW partition instead of silently keeping the old one
+        (ranks would replay overlapping shards, or drop samples whose old
+        owner no longer exists).
+
+        Epoch determinism is preserved by construction: the permutation is
+        seeded by ``(seed, epoch)`` only — never by the world — so a
+        sampler re-sharded to ``(rank, num_replicas)`` yields exactly what
+        a fresh ``DistributedSampler(dataset, num_replicas, rank)`` at the
+        same epoch would, and the union over new ranks covers the same
+        sample set the old world was iterating."""
+        num_replicas, rank = int(num_replicas), int(rank)
+        if not 0 <= rank < num_replicas:
+            raise ValueError(
+                f"rank must be in [0, {num_replicas}), got rank={rank}")
+        self.num_replicas = num_replicas
+        self.rank = rank
+        n = len(self.dataset)
         # torch-exact shard sizing (tests/test_sampler.py::TestTorchParity)
         if self.drop_last and n % num_replicas != 0:
             self.num_samples = math.ceil((n - num_replicas) / num_replicas)
         else:
             self.num_samples = math.ceil(n / num_replicas)
         self.total_size = self.num_samples * num_replicas
-
-    def set_epoch(self, epoch: int) -> None:
-        self.epoch = epoch
 
     def __iter__(self):
         n = len(self.dataset)

@@ -47,7 +47,7 @@ import socket
 import subprocess
 import sys
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 __all__ = ["main", "build_parser"]
 
@@ -86,6 +86,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "--max_restarts; needs the store, so not with "
                         "--no_store). Children see TPU_DIST_RESTART_COUNT "
                         "and should resume from their latest checkpoint")
+    p.add_argument("--elastic_world", type=str, default=None,
+                   metavar="MIN:MAX",
+                   help="elastic world-size range (single-node). A worker "
+                        "exiting with PREEMPTED_EXIT_CODE (117: pod "
+                        "preempted for good; the chaos `shrink` fault) "
+                        "re-forms the gang at the surviving rank count "
+                        "instead of burning --max_restarts relaunching a "
+                        "world that can never fill; GROW_EXIT_CODE (118: "
+                        "capacity returned; the chaos `grow` fault) "
+                        "re-forms at MAX. World-size changes don't count "
+                        "against --max_restarts. Workers resume from "
+                        "sharded checkpoints via elastic resharding "
+                        "(resilience.TrainState; docs/resilience.md)")
     p.add_argument("--elastic_timeout", type=float, default=120.0,
                    help="seconds to wait for every launcher to join the "
                         "restart agreement before giving up (multi-node "
@@ -220,19 +233,23 @@ def _check_liveness(store, world_size: int) -> List[int]:
 
 
 def _spawn_world(args, world_size: int, master_port: int,
-                 store_addr: Optional[str],
-                 restart_count: int) -> List[subprocess.Popen]:
+                 store_addr: Optional[str], restart_count: int,
+                 nproc: Optional[int] = None) -> List[subprocess.Popen]:
     """Spawn this node's ranks; on partial failure kill the already-spawned
-    ranks (never leave them orphaned in the rendezvous wait) and re-raise."""
+    ranks (never leave them orphaned in the rendezvous wait) and re-raise.
+    ``nproc`` overrides ``--nproc_per_node`` for elastic rounds whose world
+    shrank or grew."""
     procs: List[subprocess.Popen] = []
+    if nproc is None:
+        nproc = args.nproc_per_node
     try:
-        for local_rank in range(args.nproc_per_node):
+        for local_rank in range(nproc):
             rank = args.node_rank * args.nproc_per_node + local_rank
             env = dict(os.environ,
                        RANK=str(rank),
                        LOCAL_RANK=str(local_rank),
                        WORLD_SIZE=str(world_size),
-                       LOCAL_WORLD_SIZE=str(args.nproc_per_node),
+                       LOCAL_WORLD_SIZE=str(nproc),
                        NODE_RANK=str(args.node_rank),
                        MASTER_ADDR=args.master_addr,
                        MASTER_PORT=str(master_port),
@@ -288,8 +305,12 @@ def _request_obs_dumps(args, procs: List[subprocess.Popen],
 def _watch_world(args, procs: List[subprocess.Popen], store,
                  world_size: int, rnd: int = 0):
     """Monitor one round until every rank exits → ``(exit_code,
-    interrupted)``; ``interrupted`` distinguishes launcher Ctrl-C (never
-    restarted) from a worker that happened to exit with code 130.
+    interrupted, rcs)``; ``interrupted`` distinguishes launcher Ctrl-C
+    (never restarted) from a worker that happened to exit with code 130,
+    and ``rcs`` carries each local rank's exit code so ``--elastic_world``
+    can tell preempted ranks (117) and grow requests (118) from crashes.
+    Ranks reaped only AFTER this loop's own teardown TERM report ``None``
+    — their exit code is a response to the shutdown, not a preemption.
 
     Fail fast: first non-zero exit kills the rest (mp.spawn-style semantics
     the reference depends on; torch.distributed.launch exits similarly).
@@ -303,6 +324,15 @@ def _watch_world(args, procs: List[subprocess.Popen], store,
     key on the store; every launcher polls it (~0.5 s) and tears down its
     own workers on sight, so the whole world stops together — the
     restart *agreement* happens afterwards in :func:`_elastic_agree`.
+
+    ``--elastic_world`` exception to fail-fast: preemptions arrive in
+    BATCHES (a spot reclaim takes several pods in one sweep), but this
+    loop's first-exit teardown would TERM the not-yet-preempted siblings
+    before their own 117s land, miscounting the survivors and re-forming
+    at the wrong world.  So when the first failing exit is the elastic
+    protocol (PREEMPTED/GROW), teardown waits a short settle window
+    (``TPU_DIST_PREEMPT_SETTLE``, default 2 s) collecting further elastic
+    exits; any ordinary crash still tears down immediately.
     """
     kill_grace = 15.0
     exit_code = 0
@@ -330,6 +360,19 @@ def _watch_world(args, procs: List[subprocess.Popen], store,
             generation=rnd,
             startup_grace=max(args.heartbeat_timeout, args.liveness_warn))
         hb_poll_every = min(0.5, args.heartbeat_timeout / 4)
+    from ..resilience.chaos import GROW_EXIT_CODE, PREEMPTED_EXIT_CODE
+    elastic_rcs = (PREEMPTED_EXIT_CODE, GROW_EXIT_CODE)
+    try:
+        settle = float(os.environ.get("TPU_DIST_PREEMPT_SETTLE", "2.0"))
+    except ValueError:
+        settle = 2.0
+    teardown_at = None    # when to TERM the still-running ranks
+    teardown_done = False
+    # exit codes reaped BEFORE the launcher's own teardown TERM went out:
+    # a survivor whose --exit-on-preempt handler converts that TERM into
+    # a 117 is being shut down by US, not preempted — counting it would
+    # collapse the survivor count and veto the shrink it is part of
+    pre_teardown_rcs: Dict[int, int] = {}
     try:
         remaining = set(range(len(procs)))
         while remaining:
@@ -349,23 +392,37 @@ def _watch_world(args, procs: List[subprocess.Popen], store,
                 if rc is None:
                     continue
                 remaining.discard(i)
+                if not teardown_done:
+                    pre_teardown_rcs[i] = rc
                 if rc == 0 and monitor is not None:
                     # finished ranks are done, not lost — even if they
                     # raced past their terminal exit beat
                     monitor.mark_done(
                         args.node_rank * args.nproc_per_node + i)
-                if rc != 0 and exit_code == 0:
-                    exit_code = rc
-                    if elastic:
-                        try:
-                            store.set(fail_key,
-                                      str(args.node_rank).encode())
-                        except Exception:
-                            pass
-                    _request_obs_dumps(args, procs, remaining)
-                    for j in remaining:
-                        procs[j].terminate()
-                    kill_deadline = time.monotonic() + kill_grace
+                if rc != 0:
+                    if exit_code == 0:
+                        exit_code = rc
+                        if elastic:
+                            try:
+                                store.set(fail_key,
+                                          str(args.node_rank).encode())
+                            except Exception:
+                                pass
+                    if args.elastic_world and rc in elastic_rcs:
+                        # batched preemption: let sibling 117/118s land
+                        # before tearing down, so the survivor count (and
+                        # hence the re-formed world size) is right
+                        if teardown_at is None:
+                            teardown_at = time.monotonic() + settle
+                    else:
+                        teardown_at = time.monotonic()
+            if (teardown_at is not None and not teardown_done
+                    and time.monotonic() >= teardown_at):
+                teardown_done = True
+                _request_obs_dumps(args, procs, remaining)
+                for j in remaining:
+                    procs[j].terminate()
+                kill_deadline = time.monotonic() + kill_grace
             if (elastic and exit_code == 0 and not remote_failed
                     and time.monotonic() - last_remote_check > 0.5):
                 last_remote_check = time.monotonic()
@@ -375,6 +432,10 @@ def _watch_world(args, procs: List[subprocess.Popen], store,
                         sys.stderr.write(
                             "[tpu_dist.launch] another node reported a "
                             "worker failure; stopping local workers\n")
+                        # launcher-initiated TERM: a survivor converting
+                        # it into a 117 is being shut down by us, not
+                        # preempted (see pre_teardown_rcs above)
+                        teardown_done = True
                         _request_obs_dumps(args, procs, remaining)
                         for j in remaining:
                             procs[j].terminate()
@@ -395,6 +456,11 @@ def _watch_world(args, procs: List[subprocess.Popen], store,
                             store.set(fail_key, str(args.node_rank).encode())
                         except Exception:
                             pass
+                    # launcher-initiated TERM (hung rank): survivors'
+                    # --exit-on-preempt 117s are OUR shutdown, not a
+                    # preemption — without this a hang would silently
+                    # shrink the world instead of burning a restart
+                    teardown_done = True
                     _request_obs_dumps(args, procs, remaining)
                     for j in remaining:
                         procs[j].terminate()
@@ -425,7 +491,8 @@ def _watch_world(args, procs: List[subprocess.Popen], store,
                 p.wait()
         exit_code = 130
         interrupted = True
-    return exit_code, interrupted
+    return exit_code, interrupted, [pre_teardown_rcs.get(i)
+                                    for i in range(len(procs))]
 
 
 def _report_obs(args, store, world_size: int, rnd: int) -> None:
@@ -455,6 +522,76 @@ def _report_obs(args, store, world_size: int, rnd: int) -> None:
             except Exception:
                 desc = str(tail)
         sys.stderr.write(f"  rank {r}: {desc}\n")
+
+
+def _report_reshard_plan(store, new_world: int) -> None:
+    """Print the elastic resharding plan summary next to the restart log
+    (best-effort diagnostics): the workers published their checkpoint root
+    under ``tpu_dist/elastic/ckpt_root`` (resilience.TrainState); from the
+    newest locally-resumable step's manifest the supervisor derives the
+    exact old-world → ``new_world`` fragment redistribution the re-formed
+    gang is about to run, before it starts fetching."""
+    if store is None:
+        return
+    try:
+        if not store.check("tpu_dist/elastic/ckpt_root"):
+            return
+        root = store.get("tpu_dist/elastic/ckpt_root").decode()
+        from ..resilience import reshard
+        vis = reshard.local_visibility(root)
+        steps = reshard.resumable_steps([vis])
+        if not steps:
+            return
+        step = max(steps)
+        manifest = None
+        for o in sorted(vis["shards"]):
+            if vis["shards"][o].get(step) == steps[step]:
+                manifest = reshard.load_manifest(root, step, o)
+                if manifest is not None:
+                    break
+        if manifest is None:
+            return
+        summary = reshard.plan_summary(manifest, new_world)
+        sys.stderr.write("".join(f"[tpu_dist.launch] {line}\n"
+                                 for line in summary.splitlines()))
+    except Exception:
+        pass  # a summary must never block the restart
+
+
+def _elastic_new_world(elastic_range, cur_world: int,
+                       rcs: List[Optional[int]]) -> Optional[int]:
+    """The world size the next round should re-form at, or None when this
+    failed round is NOT an elastic world change (ordinary crash — the
+    normal restart budget applies).
+
+    A worker exiting :data:`~tpu_dist.resilience.chaos.PREEMPTED_EXIT_CODE`
+    (117) announced its rank is gone for good: re-form at the surviving
+    rank count (clamped to MIN; below MIN there is no legal world, so the
+    round falls back to a budgeted full-world restart and a later retry).
+    :data:`~tpu_dist.resilience.chaos.GROW_EXIT_CODE` (118) announced
+    capacity is back: re-form at MAX — but a simultaneous preemption wins
+    (the grow request came from a world that no longer exists)."""
+    if elastic_range is None:
+        return None
+    from ..resilience.chaos import GROW_EXIT_CODE, PREEMPTED_EXIT_CODE
+    lo, hi = elastic_range
+    preempted = sum(1 for rc in rcs if rc == PREEMPTED_EXIT_CODE)
+    if preempted:
+        surviving = cur_world - preempted
+        if surviving < lo:
+            sys.stderr.write(
+                f"[tpu_dist.launch] {preempted} rank(s) preempted but "
+                f"{surviving} survivors is below --elastic_world MIN "
+                f"{lo}; retrying at the full world size\n")
+            return None
+        return surviving if surviving != cur_world else None
+    if any(rc == GROW_EXIT_CODE for rc in rcs):
+        # already at MAX: a redundant grow request (a production capacity
+        # watcher racing the regrow, or firing twice) is a free same-world
+        # relaunch, not a crash — the fall-through would kill the job at
+        # --max_restarts=0
+        return hi if hi != cur_world else cur_world
+    return None
 
 
 def _reset_round_state(store,
@@ -624,6 +761,37 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "control-plane store; drop --no_store\n")
         return 2
     world_size = args.nproc_per_node * args.nnodes
+    elastic_range = None
+    if args.elastic_world:
+        try:
+            lo, hi = (int(v) for v in args.elastic_world.split(":"))
+        except ValueError:
+            sys.stderr.write(f"--elastic_world must be MIN:MAX, got "
+                             f"{args.elastic_world!r}\n")
+            return 2
+        if not 1 <= lo <= hi:
+            sys.stderr.write(f"--elastic_world needs 1 <= MIN <= MAX, got "
+                             f"{lo}:{hi}\n")
+            return 2
+        if args.nnodes > 1:
+            # shrinking a multi-node world needs a cross-launcher
+            # agreement on WHICH node drops ranks; single-node covers the
+            # preemption story the chaos e2e proves
+            sys.stderr.write("--elastic_world is single-node "
+                             "(--nnodes=1) for now\n")
+            return 2
+        if args.no_store:
+            # generation fencing + the reshard visibility exchange ride
+            # the store; an elastic world without it could let a stale
+            # rank from the pre-shrink incarnation join the new gang
+            sys.stderr.write("--elastic_world needs the control-plane "
+                             "store; drop --no_store\n")
+            return 2
+        if not lo <= world_size <= hi:
+            sys.stderr.write(f"--nproc_per_node={args.nproc_per_node} is "
+                             f"outside --elastic_world={lo}:{hi}\n")
+            return 2
+        elastic_range = (lo, hi)
     # flight-recorder wiring: --flight-recorder (or an already-armed env)
     # resolves ONE dump dir shared by supervisor messages and every worker.
     # The env test MUST be the recorder's own parser: a bare truthiness
@@ -649,25 +817,33 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "control-plane store; fix the store setup error "
                          "above or drop --max_restarts\n")
         return 2
-    restarts = 0
+    restarts = 0   # failure budget, compared against --max_restarts
+    rnd = 0        # generation: EVERY relaunch (failure OR elastic world
+    #                change) advances it, so a re-formed gang can never
+    #                collide with a stale rank's store keyspace — which is
+    #                why world-size changes can ride outside the restart
+    #                budget in the first place
+    cur_world = world_size
+    cur_nproc = args.nproc_per_node
     try:
         while True:
             if store is not None and args.node_rank == 0:
-                _publish_generation(store, restarts)
-            procs = _spawn_world(args, world_size, master_port, store_addr,
-                                 restarts)
-            exit_code, interrupted = _watch_world(args, procs, store,
-                                                  world_size, rnd=restarts)
+                _publish_generation(store, rnd)
+            procs = _spawn_world(args, cur_world, master_port, store_addr,
+                                 rnd, nproc=cur_nproc)
+            exit_code, interrupted, rcs = _watch_world(args, procs, store,
+                                                       cur_world, rnd=rnd)
             if interrupted:
                 return exit_code
             if exit_code != 0 and args.node_rank == 0:
                 # before any reaping: the tails live under the failed
                 # generation's keyspace
-                _report_obs(args, store, world_size, restarts)
+                _report_obs(args, store, cur_world, rnd)
             if multi_node_elastic:
                 # group decision: even a node whose workers all exited 0
                 # must wait — a peer's failure restarts everyone
-                verdict, val = _elastic_agree(args, store, restarts,
+                # (rnd == restarts here: --elastic_world is single-node)
+                verdict, val = _elastic_agree(args, store, rnd,
                                               exit_code, negotiated_port,
                                               master_port)
                 if verdict == "done":
@@ -676,6 +852,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                     return val
                 master_port = val
                 restarts += 1
+                rnd += 1
                 sys.stderr.write(
                     f"[tpu_dist.launch] world failed; agreed restart "
                     f"{restarts}/{args.max_restarts} across "
@@ -684,9 +861,41 @@ def main(argv: Optional[List[str]] = None) -> int:
                        if args.obs_dir else "") + "\n")
                 _restart_backoff(args, restarts)
                 continue
+            new_world = (_elastic_new_world(elastic_range, cur_world, rcs)
+                         if exit_code != 0 else None)
+            if new_world is not None:
+                # elastic re-form: preempted ranks are gone FOR GOOD (117)
+                # or capacity returned (118) — change the world size
+                # instead of burning --max_restarts relaunching a world
+                # that can never fill.  Not a failure restart, so the
+                # budget stays untouched; the generation still advances.
+                # other nonzero rcs reaped in the same round are treated
+                # as COLLATERAL fallout of the dying gang, not charged:
+                # a preempted peer routinely takes survivors down with
+                # it (PeerGoneError, the jax coordination service's
+                # "another task died" abort) before the settle-window
+                # teardown lands, and those deaths are indistinguishable
+                # from independent crashes
+                rnd += 1
+                sys.stderr.write(
+                    f"[tpu_dist.launch] elastic world change: "
+                    f"{cur_world} -> {new_world} (generation {rnd}; "
+                    f"restart budget untouched at "
+                    f"{restarts}/{args.max_restarts}) — re-forming\n")
+                if args.node_rank == 0:
+                    _report_reshard_plan(store, new_world)
+                cur_world = new_world
+                cur_nproc = new_world  # single-node: ranks == local ranks
+                if store is not None:
+                    _reset_round_state(store, finished_round=rnd - 1)
+                _restart_backoff(args, 1)
+                if negotiated_port:
+                    master_port = _free_port()
+                continue
             if exit_code == 0 or restarts >= args.max_restarts:
                 return exit_code
             restarts += 1
+            rnd += 1
             sys.stderr.write(
                 f"[tpu_dist.launch] worker failed (rc={exit_code}); "
                 f"restart {restarts}/{args.max_restarts} — relaunching "
@@ -694,7 +903,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 + (f" (obs dumps: {args.obs_dir})"
                    if args.obs_dir else "") + "\n")
             if store is not None:
-                _reset_round_state(store, finished_round=restarts - 1)
+                _reset_round_state(store, finished_round=rnd - 1)
             _restart_backoff(args, restarts)
             if negotiated_port:
                 # the old coordinator socket may still be in TIME_WAIT;

@@ -48,9 +48,14 @@ Usage (the elastic-training loop shape)::
 ``zstate`` is a plain pytree (flat parameter shards + wrapped optimizer
 state + chunk-bounds metadata), checkpointable per rank via
 ``resilience.TrainState(..., shard=(rank, world), sharded_keys=("zero",))``.
-Sharded checkpoints are **world-size-pinned**: restoring at a different
-world size raises a named error — elastic resharding (ROADMAP item 1) is
-the follow-up that lifts this.
+The metadata records the full partition inputs (per-leaf sizes *and*
+dtypes), so shard checkpoints are **world-size-portable**: a run
+checkpointed at world N resumes at world M through elastic resharding
+(tpu_dist/resilience/reshard.py) — each new rank fetches only the byte
+spans it will own from the old shards (disk when visible, peers over the
+p2p data plane otherwise) into a fresh ``init(params)`` at the new world.
+Direct ``checkpoint.restore(shard=...)`` stays exact-match; elastic
+restores go through ``resilience.TrainState.resume``.
 """
 
 from __future__ import annotations
@@ -65,8 +70,10 @@ __all__ = ["ZeroOptimizer", "ZeroParams", "ZeroStateError"]
 
 class ZeroStateError(RuntimeError):
     """A ZeRO optimizer state does not match this run's shard layout
-    (different world size / rank / parameter structure).  Sharded states
-    are world-size-pinned until elastic resharding (ROADMAP item 1)."""
+    (different world size / rank / parameter structure).  A state built
+    at another world size is carried over by elastic resharding
+    (resilience.TrainState.resume / resilience.reshard), never loaded
+    directly."""
 
 
 class _LeafInfo:
@@ -225,6 +232,12 @@ class ZeroOptimizer:
             "span_lo": np.array([i.span[0] for i in plan.leaves], np.int64),
             "span_hi": np.array([i.span[1] for i in plan.leaves], np.int64),
             "leaf_size": np.array([i.size for i in plan.leaves], np.int64),
+            # per-leaf dtype strings: with leaf_size these are the FULL
+            # partition inputs, so a checkpointed shard is reshardable to
+            # any world size (resilience/reshard.py builds its manifest
+            # and N->M plan from exactly these two arrays)
+            "leaf_dtype": np.array([np.dtype(i.dtype).str
+                                    for i in plan.leaves]),
         }
         return {"shards": shards, "opt": self.opt.init(shards), "meta": meta}
 
@@ -239,17 +252,20 @@ class ZeroOptimizer:
             "span_lo": [i.span[0] for i in plan.leaves],
             "span_hi": [i.span[1] for i in plan.leaves],
             "leaf_size": [i.size for i in plan.leaves],
+            "leaf_dtype": [np.dtype(i.dtype).str for i in plan.leaves],
         }
         for k, v in want.items():
             got = np.asarray(meta[k]).tolist() if k in meta else None
             if got != (v if isinstance(v, list) else int(v)):
                 raise ZeroStateError(
                     f"ZeRO state layout mismatch on {k!r}: state has {got}, "
-                    f"this run needs {v}.  Sharded optimizer state is "
-                    f"world-size-pinned: it restores only at the same "
-                    f"(rank, world) and parameter structure it was saved "
-                    f"at; resuming at a different world size needs elastic "
-                    f"resharding (ROADMAP item 1).")
+                    f"this run needs {v}.  A ZeRO state is valid only at "
+                    f"the (rank, world) and parameter structure it was "
+                    f"built for; to carry a checkpointed state to a "
+                    f"different world size, restore it through elastic "
+                    f"resharding (resilience.TrainState.resume or "
+                    f"resilience.reshard.reshard_restore) into a fresh "
+                    f"init(params) at the new world.")
 
     # -- step ----------------------------------------------------------------
 

@@ -16,8 +16,16 @@ pieces a preemptible multi-host run needs, plus the harness to test them:
   heartbeat progress, and chaos step hooks, all from two calls in the loop.
 - :mod:`~tpu_dist.resilience.chaos` — deterministic, env/config-driven
   fault injection (kill rank *r* at step *k*, drop/delay store connections,
-  stall a heartbeat) so the restart machinery is exercised by tier-1 tests
-  on the CPU backend, not just believed.
+  stall a heartbeat, shrink/grow the elastic world) so the restart
+  machinery is exercised by tier-1 tests on the CPU backend, not just
+  believed.
+- :mod:`~tpu_dist.resilience.reshard` — elastic world-size resharding:
+  a sharded (ZeRO) checkpoint saved at world N resumes at world M, each
+  new rank fetching only the fragments it will own (disk range-reads or
+  peer pushes over the p2p data plane), digest-verified per fragment;
+  ``TrainState.resume`` drives it automatically and
+  ``python -m tpu_dist.launch --elastic_world=MIN:MAX`` re-forms the gang
+  at the surviving rank count after a preemption.
 
 Restart fencing lives in :mod:`tpu_dist.dist.rendezvous`: the launcher
 bumps ``tpu_dist/generation`` in the store each round and a rank from an
@@ -25,7 +33,8 @@ older incarnation is rejected at pre-flight instead of corrupting the new
 gang (veScale/torchelastic-style generation fencing).
 """
 
-from .chaos import (Chaos, ChaosError, Fault, active as active_chaos,
+from .chaos import (GROW_EXIT_CODE, PREEMPTED_EXIT_CODE, Chaos, ChaosError,
+                    Fault, active as active_chaos,
                     install as install_chaos,
                     install_from_env as install_chaos_from_env,
                     uninstall as uninstall_chaos)
@@ -37,4 +46,5 @@ __all__ = [
     "TrainState",
     "Chaos", "ChaosError", "Fault", "active_chaos", "install_chaos",
     "install_chaos_from_env", "uninstall_chaos",
+    "PREEMPTED_EXIT_CODE", "GROW_EXIT_CODE",
 ]

@@ -14,6 +14,24 @@ Grammar: ``fault[;fault...]`` where ``fault = kind[:k=v[,k=v...]]``.  Kinds:
 ``kill``           SIGKILL this process when ``on_step(step)`` hits ``step``
                    (the hard preemption: no teardown, no atexit)
 ``exit``           ``os._exit(code)`` at ``step`` (default code 1)
+``shrink``         ``os._exit(PREEMPTED_EXIT_CODE)`` at ``step`` — the
+                   pod-preemption simulation: the rank announces it is
+                   going away FOR GOOD, so a supervisor running with
+                   ``--elastic_world=min:max`` re-forms the gang at the
+                   surviving rank count instead of burning restarts
+                   relaunching a world that can never fill.  Optional
+                   ``world=`` floor: fire only while ``WORLD_SIZE`` is
+                   strictly above it — without the floor, a fault pinned
+                   to a rank that SURVIVES the shrink re-fires when the
+                   renumbered gang re-executes ``step``, cascading the
+                   world down every round
+``grow``           ``os._exit(GROW_EXIT_CODE)`` at ``step``, but only
+                   while the current ``WORLD_SIZE`` is below the fault's
+                   ``world=`` target — the capacity-returned simulation:
+                   the supervisor re-forms at the elastic maximum.  The
+                   ``world=`` guard is what keeps the fault from
+                   re-firing after the regrown gang resumes past ``step``
+                   again
 ``raise``          raise :class:`ChaosError` at ``step`` (the exception path
                    through the launcher's fail-fast)
 ``stall``          sleep ``delay`` seconds (default 600) at ``step`` while
@@ -53,11 +71,23 @@ import time
 from typing import List, Optional
 
 __all__ = ["Chaos", "ChaosError", "Fault", "parse", "install",
-           "install_from_env", "uninstall", "active"]
+           "install_from_env", "uninstall", "active",
+           "PREEMPTED_EXIT_CODE", "GROW_EXIT_CODE"]
 
-_KINDS = ("kill", "exit", "raise", "stall", "stall-heartbeat", "drop-store",
-          "delay-store")
-_STEP_KINDS = ("kill", "exit", "raise", "stall", "stall-heartbeat")
+# The elastic-world exit protocol between workers and the supervisor
+# (tpu_dist/launch/cli.py --elastic_world): a worker exiting with
+# PREEMPTED_EXIT_CODE says "this rank is gone for good — re-form without
+# me"; GROW_EXIT_CODE says "capacity is back — re-form at the elastic
+# maximum".  Production preemption handlers (GracefulShutdown loops that
+# save on SIGTERM) should sys.exit(PREEMPTED_EXIT_CODE) to get the same
+# shrink-instead-of-retry treatment the chaos faults exercise.
+PREEMPTED_EXIT_CODE = 117
+GROW_EXIT_CODE = 118
+
+_KINDS = ("kill", "exit", "raise", "stall", "stall-heartbeat", "shrink",
+          "grow", "drop-store", "delay-store")
+_STEP_KINDS = ("kill", "exit", "raise", "stall", "stall-heartbeat",
+               "shrink", "grow")
 _STORE_KINDS = ("drop-store", "delay-store")
 
 
@@ -73,6 +103,8 @@ class Fault:
     op: Optional[int] = None     # store-op-triggered kinds (1-based count)
     delay: float = 0.0           # delay-store only
     code: int = 1                # exit only
+    world: Optional[int] = None  # grow: fire while WORLD_SIZE < world;
+    #                              shrink: fire while WORLD_SIZE > world
 
     def __post_init__(self):
         if self.kind not in _KINDS:
@@ -84,6 +116,10 @@ class Fault:
             raise ValueError(f"{self.kind} fault requires op=")
         if self.kind == "delay-store" and self.delay <= 0:
             raise ValueError("delay-store fault requires delay=<seconds>")
+        if self.kind == "grow" and (self.world is None or self.world < 2):
+            raise ValueError("grow fault requires world=<target >= 2> (the "
+                             "guard that stops it re-firing once the gang "
+                             "has regrown)")
 
 
 def parse(spec: str) -> List[Fault]:
@@ -98,7 +134,7 @@ def parse(spec: str) -> List[Fault]:
                 raise ValueError(f"malformed chaos param {kv!r} in {part!r} "
                                  f"(expected key=value)")
             k = k.strip()
-            if k in ("rank", "step", "op", "code"):
+            if k in ("rank", "step", "op", "code", "world"):
                 kwargs[k] = int(v)
             elif k == "delay":
                 kwargs[k] = float(v)
@@ -143,6 +179,18 @@ class Chaos:
             elif f.kind == "exit":
                 _log("chaos-exit", rank=self.rank, step=step, code=f.code)
                 os._exit(f.code)
+            elif f.kind == "shrink":
+                cur = int(os.environ.get("WORLD_SIZE", "1") or 1)
+                if f.world is None or cur > f.world:
+                    _log("chaos-shrink", rank=self.rank, step=step,
+                         world=cur, code=PREEMPTED_EXIT_CODE)
+                    os._exit(PREEMPTED_EXIT_CODE)
+            elif f.kind == "grow":
+                cur = int(os.environ.get("WORLD_SIZE", "1") or 1)
+                if cur < f.world:
+                    _log("chaos-grow", rank=self.rank, step=step,
+                         world=cur, target=f.world, code=GROW_EXIT_CODE)
+                    os._exit(GROW_EXIT_CODE)
             elif f.kind == "raise":
                 raise ChaosError(
                     f"injected failure on rank {self.rank} at step {step}")
