@@ -19,15 +19,26 @@ collective wall time (the quantity the ISSUE 2 acceptance compares; the
 ring moves 2(N-1)/N of that on the wire per rank, the store path moves up
 to N× through one process).
 
+The dataplane all-reduce additionally runs **wire-compression** variants
+(``comm``: plain f32, ``bfloat16`` cast, ``int8_block256`` block
+quantization — tpu_dist/collectives/quant.py): same logical payload,
+compressed frames on the wire.  MB/s stays *effective* (logical bytes per
+second — the quantity the ISSUE 8 acceptance compares), and each row
+carries the measured wire-byte ``compression`` ratio from the transport
+counters.
+
 Prints one BENCH-style JSON line per measurement::
 
     {"metric": "host_collective", "op": "all_reduce", "path": "dataplane",
-     "world": 4, "bytes": 8388608, "value": 47.3, "unit": "MB/s"}
+     "comm": "int8_block256", "world": 4, "bytes": 8388608, "value": 47.3,
+     "compression": 3.88, "unit": "MB/s"}
 
-plus a final ``ring_vs_store_speedup_8MiB_w4`` summary line (the ISSUE 2
-acceptance: >= 3).  ``--smoke`` runs world=2 with one 1 MiB payload and a
-numeric cross-check in seconds — wired as a tier-1 test so the data plane
-is exercised on every PR.
+plus final summary lines: ``ring_vs_store_speedup_8MiB_w4`` (the ISSUE 2
+acceptance: >= 3) and ``quant_vs_f32_speedup_8MiB_w4`` (the ISSUE 8
+acceptance: >= 2× effective MB/s over the uncompressed ring).  ``--smoke``
+runs world=2 with one 1 MiB payload, a numeric cross-check, and a
+cross-rank byte-identity check of the quantized all-reduce, in seconds —
+wired as a tier-1 test so the data plane is exercised on every PR.
 """
 
 from __future__ import annotations
@@ -86,31 +97,65 @@ def _worker() -> int:
             return C.broadcast_host(x, group=g, src=0)
         raise ValueError(op)
 
+    from tpu_dist.obs import recorder as _rec
+
     rows = []
-    for case in spec["cases"]:
+    for ci, case in enumerate(spec["cases"]):
         nbytes, op, path, iters = (case["bytes"], case["op"], case["path"],
                                    case["iters"])
+        comm = case.get("comm")
         x = (np.random.default_rng(1000 + rank)
              .standard_normal(nbytes // 4).astype(np.float32))
         os.environ["TPU_DIST_DP_THRESHOLD"] = (
             "0" if path == "dataplane" else str(1 << 60))
+        if comm:
+            os.environ["TPU_DIST_COMM_DTYPE"] = comm
+        else:
+            os.environ.pop("TPU_DIST_COMM_DTYPE", None)
         out = run_op(op, x)  # warm-up: opens peer connections, primes numpy
         if spec.get("check") and op == "all_reduce":
+            os.environ.pop("TPU_DIST_COMM_DTYPE", None)
             os.environ["TPU_DIST_DP_THRESHOLD"] = str(1 << 60)
             ref = run_op(op, x)
-            np.testing.assert_allclose(out, ref, rtol=2e-6, atol=1e-5)
+            if comm:
+                # lossy wire: bounded relative error, and — the property
+                # compression must never cost — byte-identical results on
+                # every rank (digests compared through the store)
+                err = float(np.max(np.abs(np.asarray(out) - ref)))
+                bound = float(np.max(np.abs(ref))) * (
+                    0.1 if comm.startswith("int8") else 0.02)
+                assert err <= bound, (comm, err, bound)
+                import hashlib
+                dig = hashlib.sha256(np.ascontiguousarray(out).tobytes()) \
+                    .hexdigest().encode()
+                store.set(f"bench/qdig/{ci}/{rank}", dig)
+                store.barrier(world, tag=f"qdig{ci}")
+                digs = {store.get(f"bench/qdig/{ci}/{r}")
+                        for r in range(world)}
+                assert len(digs) == 1, f"rank-divergent quantized result"
+            else:
+                np.testing.assert_allclose(out, ref, rtol=2e-6, atol=1e-5)
+            if comm:
+                os.environ["TPU_DIST_COMM_DTYPE"] = comm
             os.environ["TPU_DIST_DP_THRESHOLD"] = (
                 "0" if path == "dataplane" else str(1 << 60))
-        tag = f"{op}/{path}/{nbytes}"
+        tag = f"{op}/{path}/{comm}/{nbytes}"
         store.barrier(world, tag=tag)
+        _rec.reset_transport_counters()
         t0 = time.perf_counter()
         for _ in range(iters):
             run_op(op, x)
         dt = time.perf_counter() - t0
-        rows.append({"metric": "host_collective", "op": op, "path": path,
-                     "world": world, "bytes": nbytes, "iters": iters,
-                     "value": round(nbytes * iters / dt / 1e6, 2),
-                     "unit": "MB/s"})
+        counters = _rec.transport_counters(reset=True).get(f"{op}/{path}")
+        row = {"metric": "host_collective", "op": op, "path": path,
+               "world": world, "bytes": nbytes, "iters": iters,
+               "comm": comm or "f32",
+               "value": round(nbytes * iters / dt / 1e6, 2),
+               "unit": "MB/s"}
+        if counters:
+            row["compression"] = round(counters["compression"], 2)
+        rows.append(row)
+    os.environ.pop("TPU_DIST_COMM_DTYPE", None)
     if rank == 0:
         with open(os.environ["BENCH_OUT"], "w") as f:
             json.dump(rows, f)
@@ -136,11 +181,18 @@ def _run_world(world: int, sizes, iters_override, check: bool,
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     from tpu_dist.dist.store import TCPStore
 
-    cases = [{"op": op, "path": path, "bytes": nbytes,
+    cases = [{"op": op, "path": path, "bytes": nbytes, "comm": None,
               "iters": iters_override or _iters_for(nbytes, path)}
              for op in _OPS
              for nbytes in sizes
              for path in ("store", "dataplane")]
+    # wire-compression variants of the dataplane ring all-reduce: bf16
+    # cast vs int8 block quantization vs the plain-f32 row above
+    cases += [{"op": "all_reduce", "path": "dataplane", "bytes": nbytes,
+               "comm": comm,
+               "iters": iters_override or _iters_for(nbytes, "dataplane")}
+              for nbytes in sizes
+              for comm in ("bfloat16", "int8_block256")]
     store = TCPStore(is_master=True)
     procs = []
     try:
@@ -209,15 +261,31 @@ def main(argv=None) -> int:
             print(json.dumps(row))
         all_rows.extend(rows)
 
-    # the ISSUE 2 acceptance quantity, when its configuration was measured
-    by_key = {(r["op"], r["path"], r["world"], r["bytes"]): r["value"]
-              for r in all_rows}
-    ring = by_key.get(("all_reduce", "dataplane", 4, 8 << 20))
-    store_v = by_key.get(("all_reduce", "store", 4, 8 << 20))
+    # the ISSUE 2 / ISSUE 8 acceptance quantities, when measured
+    by_key = {(r["op"], r["path"], r.get("comm", "f32"), r["world"],
+               r["bytes"]): r["value"] for r in all_rows}
+    ring = by_key.get(("all_reduce", "dataplane", "f32", 4, 8 << 20))
+    store_v = by_key.get(("all_reduce", "store", "f32", 4, 8 << 20))
     if ring and store_v:
         print(json.dumps({"metric": "ring_vs_store_speedup_8MiB_w4",
                           "value": round(ring / store_v, 2),
                           "unit": "x", "threshold": 3.0}))
+    # quant acceptance at every measured world: on hardware where the wire
+    # is the bottleneck compression wins at any world size; on this 2-core
+    # sandbox world>cores serializes the ranks and CPU contention inverts
+    # it (even the pre-existing bf16 cast wire measures below f32 there),
+    # so the per-world rows tell the honest story — see
+    # docs/collectives.md §quantized
+    for world in worlds:
+        ring_w = by_key.get(("all_reduce", "dataplane", "f32", world,
+                             8 << 20))
+        quant_w = by_key.get(("all_reduce", "dataplane", "int8_block256",
+                              world, 8 << 20))
+        if ring_w and quant_w:
+            print(json.dumps(
+                {"metric": f"quant_vs_f32_speedup_8MiB_w{world}",
+                 "value": round(quant_w / ring_w, 2),
+                 "unit": "x", "threshold": 2.0}))
     return 0
 
 

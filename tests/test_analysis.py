@@ -523,6 +523,22 @@ _SAN_MISMATCH_WORKER = _SAN_PRELUDE + textwrap.dedent("""
                 "divergent": sorted(e.divergent), "seq": e.seq})
 """)
 
+# the two ranks run DIFFERENT wire-compression configs (skewed
+# TPU_DIST_COMM_DTYPE — e.g. one side restarted with a stale env): frames
+# would arrive in mismatched wire formats and corrupt the ring, so the
+# sanitizer must fail BOTH ranks naming BOTH schemes before payload moves
+_SAN_COMM_MISMATCH_WORKER = _SAN_PRELUDE + textwrap.dedent("""
+    os.environ["TPU_DIST_COMM_DTYPE"] = (
+        "int8_block256" if rank == 0 else "bfloat16")
+    x = np.ones(256, np.float32)
+    try:
+        C.all_reduce_host(x, group=g, op="sum")
+        finish({"error": None})
+    except CollectiveMismatchError as e:
+        finish({"error": "CollectiveMismatchError", "message": str(e),
+                "seq": e.seq})
+""")
+
 # rank 1 never calls ANY collective (the `if rank == 0: all_reduce` bug):
 # rank 0 must fail within the deadline instead of hanging
 _SAN_MISSING_WORKER = _SAN_PRELUDE + textwrap.dedent("""
@@ -603,6 +619,16 @@ class TestSanitizerE2E:
         # each rank reports the OTHER side as divergent from its majority
         assert any("all_reduce" in out["message"]
                    and "broadcast" in out["message"] for out in res)
+
+    def test_mismatched_comm_scheme_fails_naming_both(self, tmp_path):
+        res = _spawn_sanitized(tmp_path, _SAN_COMM_MISMATCH_WORKER)
+        for r, out in enumerate(res):
+            assert out["error"] == "CollectiveMismatchError", (r, out)
+            # the first-divergence detail names BOTH schemes, so the fix
+            # (align TPU_DIST_COMM_DTYPE) is readable off the error
+            assert "comm" in out["message"], out["message"]
+            assert "int8_block256" in out["message"], out["message"]
+            assert "bfloat16" in out["message"], out["message"]
 
     def test_missing_rank_fails_within_deadline_not_hang(self, tmp_path):
         res = _spawn_sanitized(tmp_path, _SAN_MISSING_WORKER)

@@ -1,0 +1,575 @@
+"""Block-quantized int8 ring collectives + error feedback (ISSUE 8):
+quantize/dequantize units (block edges, non-finite policy), cross-rank
+byte-identity at worlds 2-4, wire-byte accounting, error-feedback
+convergence, and ZeRO integration (shard-resident residual riding the
+checkpoint layout and the reshard manifest).
+
+In-process rigs throughout (one DataPlane per fake rank, threads), the
+test_zero wiring — worlds 2-4 run in seconds with no process spawns; the
+spawned/e2e coverage of the quantized wire rides the bench smoke
+(tests/test_ring_collectives.py) and the sanitizer comm-mismatch e2e
+(tests/test_analysis.py).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from tpu_dist.collectives import quant as Q
+
+pytestmark = pytest.mark.quant
+
+BLOCK = 256
+SCHEME = Q.QuantScheme(BLOCK)
+
+
+@pytest.fixture
+def store():
+    from tpu_dist.dist.store import TCPStore
+    s = TCPStore(is_master=True)
+    yield s
+    s.close()
+
+
+def _run_world(store, n, fn, timeout=120):
+    from tpu_dist.collectives.transport import DataPlane
+    dps = [DataPlane(store, r, n) for r in range(n)]
+    out, errs = [None] * n, []
+
+    def run(r):
+        try:
+            out[r] = fn(dps[r], r)
+        except Exception as e:
+            errs.append((r, e))
+
+    threads = [threading.Thread(target=run, args=(r,)) for r in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout)
+    for dp in dps:
+        dp.close()
+    assert not errs, errs
+    return out
+
+
+# ---------------------------------------------------------------------------
+# scheme parsing
+# ---------------------------------------------------------------------------
+
+class TestScheme:
+    def test_parse_and_intern(self):
+        s = Q.parse_scheme("int8_block256")
+        assert s is SCHEME and s.block == 256
+        assert s.name == "int8_block256"
+        assert Q.parse_scheme("bfloat16") is None
+        assert Q.parse_scheme(None) is None
+
+    def test_resolve_wire_covers_all_spellings(self):
+        assert Q.resolve_wire(None) is None
+        assert Q.resolve_wire("int8_block128").block == 128
+        assert Q.resolve_wire("float16") == np.dtype(np.float16)
+        import ml_dtypes
+        assert Q.resolve_wire("bfloat16") == np.dtype(ml_dtypes.bfloat16)
+        assert Q.wire_name(Q.resolve_wire("int8_block64")) == "int8_block64"
+        assert Q.wire_name(Q.resolve_wire("bfloat16")) == "bfloat16"
+        assert Q.wire_name(None) is None
+
+    def test_wire_math(self):
+        assert SCHEME.scales_for(0) == 0
+        assert SCHEME.scales_for(1) == 1
+        assert SCHEME.scales_for(256) == 1
+        assert SCHEME.scales_for(257) == 2
+        # ~3.9x below f32 at block 256
+        assert SCHEME.wire_bytes(4096) == 4096 + 4 * 16
+
+    def test_bad_specs_raise(self):
+        with pytest.raises(ValueError):
+            Q.QuantScheme(0)
+        with pytest.raises(Exception):
+            Q.resolve_wire("no_such_dtype")
+
+
+# ---------------------------------------------------------------------------
+# quantize / dequantize units
+# ---------------------------------------------------------------------------
+
+class TestRoundTrip:
+    @pytest.mark.parametrize("n", [0, 1, 3, 255, 256, 257, 1000, 4096, 5001])
+    def test_error_bounded_by_half_scale(self, n):
+        x = np.random.default_rng(n).standard_normal(n).astype(np.float32)
+        q, s = Q.quantize(x, SCHEME)
+        assert q.dtype == np.int8 and q.size == n
+        assert s.dtype == np.float32 and s.size == SCHEME.scales_for(n)
+        d = Q.dequantize(q, s, SCHEME)
+        if n:
+            # symmetric int8: |x - q*scale| <= scale/2 per block (+ f32
+            # arithmetic slack)
+            bound = np.repeat(s, BLOCK)[:n] * 0.5 + 1e-7
+            assert (np.abs(d - x) <= bound).all()
+
+    def test_deterministic_bytes(self):
+        x = np.random.default_rng(0).standard_normal(999).astype(np.float32)
+        a = Q.quantize(x, SCHEME)
+        b = Q.quantize(x.copy(), SCHEME)
+        assert a[0].tobytes() == b[0].tobytes()
+        assert a[1].tobytes() == b[1].tobytes()
+
+    def test_zero_block_exact_zero(self):
+        x = np.zeros(512, np.float32)
+        x[300] = 1.0  # second block nonzero, first all-zero
+        q, s = Q.quantize(x, SCHEME)
+        assert s[0] == 0.0 and (q[:256] == 0).all()
+        d = Q.dequantize(q, s, SCHEME)
+        assert (d[:256] == 0).all() and d[300] != 0
+
+    def test_subnormal_block_underflows_to_zero(self):
+        # amax so small that 1/scale overflows f32: the block is zero at
+        # int8 resolution — exact zeros, never inf/nan garbage
+        x = np.full(64, 1e-44, np.float32)
+        q, s = Q.quantize(x, SCHEME)
+        d = Q.dequantize(q, s, SCHEME)
+        assert np.isfinite(s).all() and (d == 0).all()
+
+    def test_nonfinite_block_poisons_loudly(self):
+        x = np.zeros(3 * BLOCK, np.float32)
+        x[10] = np.inf
+        x[BLOCK + 5] = np.nan
+        q, s = Q.quantize(x, SCHEME)
+        assert np.isnan(s[0]) and np.isnan(s[1]) and s[2] == 0.0
+        assert (q == 0).all()
+        d = Q.dequantize(q, s, SCHEME)
+        # a poisoned gradient stays visibly poisoned (whole block NaN),
+        # never silently clipped into plausible values
+        assert np.isnan(d[:BLOCK]).all()
+        assert np.isnan(d[BLOCK:2 * BLOCK]).all()
+        assert (d[2 * BLOCK:] == 0).all()
+
+    def test_dequantize_dtype_and_mismatch(self):
+        x = np.random.default_rng(1).standard_normal(100).astype(np.float32)
+        q, s = Q.quantize(x, SCHEME)
+        assert Q.dequantize(q, s, SCHEME, dtype=np.float64).dtype == \
+            np.float64
+        with pytest.raises(ValueError, match="scales"):
+            Q.dequantize(q, s[:0], SCHEME)
+
+
+# ---------------------------------------------------------------------------
+# wire frames (transport)
+# ---------------------------------------------------------------------------
+
+class TestWireFrames:
+    def test_send_quant_roundtrip(self, store):
+        from tpu_dist.collectives.transport import DataPlane
+        dp0, dp1 = DataPlane(store, 0, 2), DataPlane(store, 1, 2)
+        try:
+            x = np.random.default_rng(2).standard_normal(700) \
+                .astype(np.float32)
+            q, s = Q.quantize(x, SCHEME)
+            sent = dp0.send_quant(1, "qf", Q.QuantChunk(q, s, SCHEME))
+            assert sent == q.nbytes + s.nbytes == SCHEME.wire_bytes(700)
+            got = dp1.recv_array(0, "qf", timeout=30)
+            assert isinstance(got, Q.QuantChunk)
+            assert got.size == 700 and got.scheme is SCHEME
+            np.testing.assert_array_equal(got.q, q)
+            np.testing.assert_array_equal(got.scales, s)
+            np.testing.assert_array_equal(got.dequantize(),
+                                          Q.dequantize(q, s, SCHEME))
+            # plain frames still interleave on other tags
+            dp0.send_array(1, "plain", np.arange(4))
+            assert dp1.recv_array(0, "plain", timeout=30)[3] == 3
+        finally:
+            dp0.close()
+            dp1.close()
+
+
+# ---------------------------------------------------------------------------
+# quantized ring collectives: byte identity + accuracy
+# ---------------------------------------------------------------------------
+
+class TestRingQuant:
+    @pytest.mark.parametrize("world", [2, 3, 4])
+    @pytest.mark.parametrize("op", ["sum", "avg"])
+    def test_all_reduce_byte_identical_and_close(self, store, world, op):
+        from tpu_dist.collectives import ring
+        for size in (3, 300, 1001, 70000):  # < world, < block, uneven, big
+            vals = [np.random.default_rng(50 + r).standard_normal(size)
+                    .astype(np.float32) for r in range(world)]
+            exact = np.sum(vals, axis=0)
+            if op == "avg":
+                exact = exact / world
+            outs = _run_world(
+                store, world,
+                lambda dp, r: ring.ring_all_reduce(
+                    dp, vals[r], op=op, comm_dtype="int8_block256",
+                    tag=f"q{op}{world}_{size}"))
+            b0 = outs[0].tobytes()
+            assert all(o.tobytes() == b0 for o in outs), (world, size)
+            err = float(np.abs(outs[0] - exact).max())
+            assert err <= 0.05 * max(float(np.abs(exact).max()), 1.0), \
+                (world, size, err)
+
+    def test_reduce_scatter_shard_equals_all_reduce_span(self, store):
+        from tpu_dist.collectives import ring
+        world, size = 3, 1001
+        vals = [np.random.default_rng(7 + r).standard_normal(size)
+                .astype(np.float32) for r in range(world)]
+        full = _run_world(store, world, lambda dp, r: ring.ring_all_reduce(
+            dp, vals[r], op="sum", comm_dtype="int8_block256", tag="qar"))
+        frags = _run_world(store, world,
+                           lambda dp, r: ring.ring_reduce_scatter(
+                               dp, vals[r], op="sum",
+                               comm_dtype="int8_block256", tag="qrs"))
+        for r in range(world):
+            lo, hi = ring.ring_chunk_span(size, world, r)
+            assert frags[r].tobytes() == full[r][lo:hi].tobytes(), r
+
+    def test_chunk_all_gather_quant_byte_identical(self, store):
+        from tpu_dist.collectives import ring
+        world, size = 3, 2000
+        bounds = ring._bounds(size, world)
+
+        def gather(dp, r):
+            buf = np.zeros(size, np.float32)
+            lo, hi = bounds[r]
+            buf[lo:hi] = np.random.default_rng(40 + r) \
+                .standard_normal(hi - lo).astype(np.float32)
+            return ring.ring_chunk_all_gather(
+                dp, buf, bounds, tag="qcag", comm_dtype="int8_block256")
+
+        outs = _run_world(store, world, gather)
+        b0 = outs[0].tobytes()
+        assert all(o.tobytes() == b0 for o in outs)
+
+    def test_all_gather_quant_byte_identical(self, store):
+        from tpu_dist.collectives import ring
+        world = 3
+        vals = [np.random.default_rng(60 + r).standard_normal(999)
+                .astype(np.float32) for r in range(world)]
+        outs = _run_world(store, world, lambda dp, r: ring.ring_all_gather(
+            dp, vals[r], tag="qag", comm_dtype="int8_block256"))
+        b0 = outs[0].tobytes()
+        assert all(o.tobytes() == b0 for o in outs)
+        # each rank's own block was compressed at the source too
+        err = np.abs(outs[0][1] - vals[1]).max()
+        assert 0 < err <= 0.05 * np.abs(vals[1]).max()
+
+    def test_gather_compression_applies_to_bf16_payloads(self, store):
+        # ml_dtypes floats register as numpy kind 'V': the gather-path
+        # float gate must still recognize them as compressible floats
+        import ml_dtypes
+        from tpu_dist.collectives import ring
+        world = 2
+        vals = [np.random.default_rng(70 + r).standard_normal(800)
+                .astype(ml_dtypes.bfloat16) for r in range(world)]
+        stats = [{} for _ in range(world)]
+        outs = _run_world(store, world, lambda dp, r: ring.ring_all_gather(
+            dp, vals[r], tag="bfq", comm_dtype="int8_block256",
+            stats=stats[r]))
+        assert outs[0].tobytes() == outs[1].tobytes()
+        assert stats[0]["comm"] == "int8_block256"
+        assert stats[0]["wire_bytes"] < stats[0]["raw_wire_bytes"]
+
+    def test_stats_report_compressed_wire_bytes(self, store):
+        from tpu_dist.collectives import ring
+        world, size = 2, 100000
+        vals = [np.random.default_rng(r).standard_normal(size)
+                .astype(np.float32) for r in range(world)]
+        stats = [{} for _ in range(world)]
+        _run_world(store, world, lambda dp, r: ring.ring_all_reduce(
+            dp, vals[r], op="sum", comm_dtype="int8_block256", tag="st",
+            stats=stats[r]))
+        logical = size * 4  # f32 payload
+        for st in stats:
+            assert st["comm"] == "int8_block256"
+            # per-rank wire traffic ~ 2(N-1)/N of the payload, at ~1 byte
+            # + scales per element instead of 4
+            assert 0 < st["wire_bytes"] < logical / 2
+            # raw = what the SAME traffic costs uncompressed, so the
+            # ratio is the FORMAT compression (~3.9x at block 256), not
+            # polluted by the ring's 2(N-1)/N amplification
+            assert st["raw_wire_bytes"] > st["wire_bytes"]
+            assert 3.5 < st["raw_wire_bytes"] / st["wire_bytes"] < 4.0
+        stats2: dict = {}
+        _run_world(store, world, lambda dp, r: ring.ring_all_reduce(
+            dp, vals[r], op="sum", tag="st2",
+            stats=stats2 if r == 0 else None))
+        assert stats2["comm"] is None
+        assert stats2["wire_bytes"] > logical / 2  # raw f32 frames
+        # uncompressed: ratio exactly 1.0 at ANY world size
+        assert stats2["raw_wire_bytes"] == stats2["wire_bytes"]
+
+    def test_int_payload_stays_exact(self, store):
+        # quant schemes never apply to exact integer arithmetic: the gate
+        # depends only on dtype, so every rank agrees
+        from tpu_dist.collectives import ring
+        world = 2
+        vals = [np.arange(1000, dtype=np.int32) * (r + 1)
+                for r in range(world)]
+        outs = _run_world(store, world, lambda dp, r: ring.ring_all_reduce(
+            dp, vals[r], op="sum", comm_dtype="int8_block256", tag="iq"))
+        np.testing.assert_array_equal(outs[0], np.arange(1000) * 3)
+
+    def test_bf16_payload_quantizes_via_f32_accumulator(self, store):
+        import ml_dtypes
+        from tpu_dist.collectives import ring
+        world = 2
+        vals = [np.random.default_rng(r).standard_normal(600)
+                .astype(ml_dtypes.bfloat16) for r in range(world)]
+        outs = _run_world(store, world, lambda dp, r: ring.ring_all_reduce(
+            dp, vals[r], op="sum", comm_dtype="int8_block256", tag="bq"))
+        assert outs[0].dtype == ml_dtypes.bfloat16
+        assert outs[0].tobytes() == outs[1].tobytes()
+        exact = (vals[0].astype(np.float32) + vals[1].astype(np.float32))
+        err = np.abs(outs[0].astype(np.float32) - exact).max()
+        assert err <= 0.1 * np.abs(exact).max()
+
+    def test_bad_residual_size_raises(self, store):
+        from tpu_dist.collectives import ring
+        world = 2
+        vals = [np.zeros(100, np.float32) for _ in range(world)]
+
+        def run(dp, r):
+            with pytest.raises(ValueError, match="quant_residual"):
+                ring.ring_all_reduce(dp, vals[r], op="sum",
+                                     comm_dtype="int8_block256", tag="br",
+                                     quant_residual=np.zeros(7, np.float32))
+            return True
+
+        assert _run_world(store, world, run) == [True, True]
+
+
+# ---------------------------------------------------------------------------
+# error feedback: the residual loop beats plain quantization
+# ---------------------------------------------------------------------------
+
+class TestErrorFeedback:
+    def _train(self, store, world, comm, use_ef, steps=60, lr=0.2):
+        """Distributed least squares: w tracks the mean of rank-local
+        targets; returns (final distance to optimum, final bytes)."""
+        D = 1500
+        rng = np.random.default_rng(3)
+        target = rng.standard_normal(D).astype(np.float32) * 3
+        locals_ = [target + rng.standard_normal(D).astype(np.float32) * 0.5
+                   for _ in range(world)]
+
+        def run(dp, r):
+            from tpu_dist.collectives.bucketer import Bucketer
+            bk = Bucketer(bucket_bytes=1 << 20, dp=dp, comm_dtype=comm)
+            ef = Q.ErrorFeedback() if use_ef else None
+            w = np.zeros(D, np.float32)
+            for _ in range(steps):
+                g = w - locals_[r]
+                g = bk.all_reduce({"g": g}, op="avg",
+                                  error_feedback=ef).wait_all(60)["g"]
+                w = w - lr * g
+            return (float(np.linalg.norm(w - np.mean(locals_, axis=0))),
+                    w.tobytes(), ef.norm() if ef else 0.0)
+
+        res = _run_world(store, world, run)
+        assert all(b == res[0][1] for _, b, _ in res), "rank divergence"
+        return res[0]
+
+    def test_ef_shrinks_quantization_floor(self, store):
+        world = 3
+        d_f32, _, _ = self._train(store, world, None, False)
+        d_q, _, _ = self._train(store, world, "int8_block256", False)
+        d_ef, _, ef_norm = self._train(store, world, "int8_block256", True)
+        # f32 converges to ~0; plain quantization leaves a noise floor;
+        # the hop+owner residual loop recovers most of it
+        assert d_f32 < 1e-3
+        assert d_q > 5 * d_f32
+        assert d_ef < 0.5 * d_q, (d_f32, d_q, d_ef)
+        assert ef_norm > 0  # the residual is genuinely carrying mass
+
+    def test_ef_applies_to_cast_wire_too(self, store):
+        # the residual loop is wire-format-agnostic: bf16 cast loses
+        # mantissa bits, EF feeds them back
+        world = 2
+        d_cast, _, _ = self._train(store, world, "bfloat16", False)
+        d_ef, _, _ = self._train(store, world, "bfloat16", True)
+        assert d_ef < d_cast
+
+    def test_residual_layout_mismatch_raises(self):
+        ef = Q.ErrorFeedback()
+        ef.residual_for("k", 10, np.float32)
+        with pytest.raises(ValueError, match="different world size"):
+            ef.residual_for("k", 20, np.float32)
+
+    def test_transient_nonfinite_poisons_one_step_not_forever(self, store):
+        """A single inf gradient (a routine loss-scaling overflow step)
+        poisons THAT step's output loudly, but must not lodge NaN in the
+        residual and re-poison every later step."""
+        from tpu_dist.collectives import ring
+        world, size = 2, 600
+        efs = [np.zeros(size, np.float32) for _ in range(world)]
+
+        def step(dp, r, bad):
+            x = np.ones(size, np.float32)
+            if bad and r == 0:
+                x[10] = np.inf
+            return ring.ring_all_reduce(
+                dp, x, op="sum", comm_dtype="int8_block256",
+                tag=f"nf{bad}", quant_residual=efs[r])
+
+        poisoned = _run_world(store, world,
+                              lambda dp, r: step(dp, r, True))
+        assert np.isnan(poisoned[0]).any()  # loud THIS step
+        for e in efs:
+            assert np.isfinite(e).all()     # ...but the residual is clean
+        clean = _run_world(store, world, lambda dp, r: step(dp, r, False))
+        assert np.isfinite(clean[0]).all()  # fully recovered next step
+        np.testing.assert_allclose(clean[0], 2.0, atol=0.1)
+
+
+# ---------------------------------------------------------------------------
+# ZeRO integration: shard-resident residual
+# ---------------------------------------------------------------------------
+
+def _params(seed=99):
+    g = np.random.default_rng(seed)
+    return {"w1": g.standard_normal(1001).astype(np.float32),
+            "w2": g.standard_normal((7, 13)).astype(np.float32),
+            "b": np.float32(g.standard_normal())}
+
+
+class TestZeroQuant:
+    @pytest.mark.parametrize("world", [2, 4])
+    def test_params_byte_identical_and_ef_rides_state(self, store, world):
+        from tpu_dist import optim
+        from tpu_dist.parallel.zero import ZeroOptimizer
+        params = _params()
+
+        def run(dp, r):
+            z = ZeroOptimizer(optim.Adam(1e-2), dp=dp,
+                              comm_dtype="int8_block256",
+                              error_feedback=True, bucket_bytes=4096)
+            st = z.init(params)
+            assert set(st["ef"]) == set(st["shards"])
+            for k in st["ef"]:
+                assert st["ef"][k].shape == st["shards"][k].shape
+            p = params
+            for step in range(3):
+                g = _params(10 + step)  # identical grads on every rank
+                rs = z.reduce_scatter(g, state=st)
+                h, st = z.update(rs, st)
+                p = h.wait(60)
+            return p, st
+
+        res = _run_world(store, world, run)
+        for k in res[0][0]:
+            b0 = np.asarray(res[0][0][k]).tobytes()
+            assert all(np.asarray(p[k]).tobytes() == b0 for p, _ in res), k
+        # the residual picked up real compression error
+        assert any(np.asarray(v).any()
+                   for v in res[0][1]["ef"].values())
+
+    def test_reduce_scatter_requires_state_when_ef_on(self, store):
+        from tpu_dist import optim
+        from tpu_dist.parallel.zero import ZeroOptimizer, ZeroStateError
+
+        def run(dp, r):
+            z = ZeroOptimizer(optim.SGD(0.1), dp=dp,
+                              comm_dtype="int8_block256",
+                              error_feedback=True)
+            z.init(_params())
+            with pytest.raises(ZeroStateError, match="state=zstate"):
+                z.reduce_scatter(_params(1))
+            return True
+
+        assert all(_run_world(store, 2, run))
+
+    def test_missing_ef_state_resets_to_zeros(self, store):
+        # a pre-quant checkpoint (no "ef") restores cleanly: the residual
+        # resets, costing one step of compression error, never an error
+        from tpu_dist import optim
+        from tpu_dist.parallel.zero import ZeroOptimizer
+
+        def run(dp, r):
+            z = ZeroOptimizer(optim.SGD(0.1), dp=dp,
+                              comm_dtype="int8_block256",
+                              error_feedback=True, bucket_bytes=4096)
+            st = z.init(_params())
+            del st["ef"]
+            rs = z.reduce_scatter(_params(1), state=st)
+            h, st = z.update(rs, st)
+            h.wait(60)
+            return "ef" in st
+
+        assert all(_run_world(store, 2, run))
+
+    def test_ef_shards_ride_reshard_manifest(self, store):
+        """The residual arrays have the exact flat per-group shard layout,
+        so manifest_from_arrays classifies them as sharded — an elastic
+        N->M restore redistributes them like any optimizer state."""
+        from tpu_dist import optim
+        from tpu_dist.parallel.zero import ZeroOptimizer
+        from tpu_dist.resilience.reshard import manifest_from_arrays
+        params = _params()
+
+        def run(dp, r):
+            z = ZeroOptimizer(optim.Adam(1e-2), dp=dp,
+                              comm_dtype="int8_block256",
+                              error_feedback=True, bucket_bytes=4096)
+            st = z.init(params)
+            rs = z.reduce_scatter(_params(1), state=st)
+            h, st = z.update(rs, st)
+            h.wait(60)
+            return st
+
+        st = _run_world(store, 2, run)[1]
+        flat = {}
+
+        def walk(prefix, t):
+            if isinstance(t, dict):
+                for k, v in t.items():
+                    walk(prefix + f"['{k}']", v)
+            else:
+                flat[prefix] = np.asarray(t)
+
+        walk("['zero']", st)
+        m = manifest_from_arrays(flat)
+        sharded = m["entries"]["['zero']"]["sharded"]
+        assert any("'ef'" in p for p in sharded), sorted(sharded)
+
+
+# ---------------------------------------------------------------------------
+# the accuracy gate (benchmarks/accuracy_run.py run_quant_ef_gate)
+# ---------------------------------------------------------------------------
+
+class TestAccuracyGate:
+    def test_recorded_gate_row_within_noise(self):
+        """The recorded end-to-end gate (``accuracy_run.py
+        --quant-gate-only``: 150 steps of world-2 ConvNet training on the
+        low-SNR oracle with host-path bucketed grad averaging, f32 wire vs
+        int8_block256 + error feedback on the identical deterministic
+        schedule) must sit inside its ±3-SE band — the in-repo pin of the
+        ISSUE 8 accuracy acceptance.  The full run retrains below under
+        the slow tier."""
+        import json
+        import os
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "ACCURACY.json")
+        rows = json.load(open(path))
+        row = rows.get("mnist_convnet_quant_ef_gate") \
+            or rows.get("cifar_resnet_quant_ef_gate")
+        assert row is not None, \
+            "quant EF gate not recorded — run benchmarks/accuracy_run.py " \
+            "--quant-gate-only"
+        assert row["scheme"].startswith("int8_block")
+        assert row["within_noise"], row
+        assert abs(row["delta"]) <= row["noise_band_3se"], row
+
+    @pytest.mark.slow
+    @pytest.mark.multiprocess
+    def test_gate_retrains_within_noise(self):
+        """Full-length retrain of the recorded gate.  The step count must
+        stay at the recorded recipe's 150: the ±3-SE band is only valid
+        once both runs have converged to the oracle ceiling — mid-training
+        (e.g. 40 steps) the accuracy sits on a cliff where any
+        perturbation swings it far beyond any honest noise band."""
+        from benchmarks.accuracy_run import run_quant_ef_gate
+        row = run_quant_ef_gate(steps=150, batch=128, n_train=12000,
+                                n_test=3000)
+        assert row["within_noise"], row

@@ -49,8 +49,12 @@ __all__ = ["CollectiveMismatchError", "enabled", "check_collective",
 
 # fields that must agree across ranks (compared); "site"/"rank" are
 # diagnostic only — the same collective may legitimately be reached from
-# different lines (e.g. matching calls in both branches of a conditional)
-SEMANTIC_FIELDS = ("op", "reduce", "tree", "leaves", "src", "dst")
+# different lines (e.g. matching calls in both branches of a conditional).
+# "comm" is the wire-compression scheme (TPU_DIST_COMM_DTYPE — a dtype
+# cast or an int8 block-quant spec): ranks running different schemes would
+# exchange frames in different wire formats and corrupt the ring, so a
+# skewed compression config fails here naming both schemes instead.
+SEMANTIC_FIELDS = ("op", "reduce", "tree", "leaves", "src", "dst", "comm")
 
 _seq = 0  # process-local sanitized-collective counter
 
@@ -99,7 +103,7 @@ def _call_site() -> str:
 
 def _signature(op: str, rank: int, value: Any = None,
                reduce_op: Optional[str] = None, src: Optional[int] = None,
-               dst: Optional[int] = None,
+               dst: Optional[int] = None, comm: Optional[str] = None,
                with_leaves: bool = True) -> Dict:
     sig: Dict[str, Any] = {"op": op, "rank": rank, "site": _call_site()}
     if reduce_op is not None:
@@ -108,6 +112,8 @@ def _signature(op: str, rank: int, value: Any = None,
         sig["src"] = int(src)
     if dst is not None:
         sig["dst"] = int(dst)
+    if comm is not None:
+        sig["comm"] = str(comm)
     if value is not None and with_leaves:
         import jax
         import numpy as np
@@ -136,6 +142,7 @@ def _ns() -> str:
 def check_collective(group, store, op: str, value: Any = None,
                      reduce_op: Optional[str] = None,
                      src: Optional[int] = None, dst: Optional[int] = None,
+                     comm: Optional[str] = None,
                      with_leaves: bool = True) -> None:
     """Publish this rank's signature for the next sanitized collective and
     verify every peer announced an identical one; raises
@@ -151,7 +158,7 @@ def check_collective(group, store, op: str, value: Any = None,
         return
     seq, _seq = _seq, _seq + 1
     mine = _signature(op, me, value=value, reduce_op=reduce_op, src=src,
-                      dst=dst, with_leaves=with_leaves)
+                      dst=dst, comm=comm, with_leaves=with_leaves)
     base = f"{_ns()}/{seq}"
     store.set(f"{base}/{me}", json.dumps(mine, sort_keys=True).encode())
 

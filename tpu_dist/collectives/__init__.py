@@ -48,6 +48,11 @@ from . import bucketer, work
 from .work import Work, wait_all
 from .bucketer import (Bucketer, BucketWork, bucketed_all_reduce,
                        bucketed_reduce_scatter)
+# block-quantized int8 wire format (EQuARX-style) + error feedback:
+# selectable wherever comm_dtype is accepted (TPU_DIST_COMM_DTYPE=
+# int8_block256, Bucketer/ZeroOptimizer comm_dtype=...)
+from . import quant
+from .quant import ErrorFeedback, QuantScheme
 
 __all__ = [
     "all_reduce", "all_gather", "reduce_scatter", "broadcast", "all_to_all",
@@ -60,4 +65,5 @@ __all__ = [
     "ring", "transport", "DataPlane", "PeerGoneError",
     "work", "Work", "wait_all", "bucketer", "Bucketer", "BucketWork",
     "bucketed_all_reduce", "bucketed_reduce_scatter",
+    "quant", "QuantScheme", "ErrorFeedback",
 ]

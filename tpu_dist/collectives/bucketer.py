@@ -210,19 +210,30 @@ class Bucketer:
         self._seq = 0
         self._seq_mu = threading.Lock()
 
-    def all_reduce(self, tree, op: str = "avg", group=None) -> BucketWork:
+    def all_reduce(self, tree, op: str = "avg", group=None,
+                   error_feedback=None) -> BucketWork:
         """Issue bucketed async all-reduces for every leaf of ``tree``;
         returns a :class:`BucketWork` (``wait_all()`` -> reduced tree).
         ``op``: sum/avg/max/min ride the ring; anything else (and
         ring-incompatible leaves) coalesces onto the store path.
 
+        ``error_feedback`` (a
+        :class:`~tpu_dist.collectives.quant.ErrorFeedback`) activates the
+        residual loop under a lossy wire format (``comm_dtype`` cast or
+        int8 block quantization): each leaf's owner folds last step's
+        compression loss back into its chunk before compressing, and keeps
+        the new loss — pass the same object every step.  A no-op when no
+        lossy wire is configured.
+
         Leaves are **snapshotted at issue** (the pack copy happens on this
         thread, before returning), so the caller may mutate its arrays the
         moment this returns — no torch-style "don't touch until wait"
         hazard."""
-        return self._issue(tree, op, group, scatter=False)
+        return self._issue(tree, op, group, scatter=False,
+                           error_feedback=error_feedback)
 
-    def reduce_scatter(self, tree, op: str = "avg", group=None) -> BucketWork:
+    def reduce_scatter(self, tree, op: str = "avg", group=None,
+                       error_feedback=None) -> BucketWork:
         """Bucketed all-reduce **stopped at the reduce-scatter phase**:
         ``wait_all()`` returns a tree of the same structure whose leaves are
         this rank's **owned flat chunk** of each reduced leaf (1-D, span
@@ -243,10 +254,13 @@ class Bucketer:
         all-reduce and are sliced to the owned span locally — same shard
         contract on every transport.  At world 1 the "shard" is the whole
         (flattened) leaf.  Inputs are snapshotted at issue, like
-        :meth:`all_reduce`."""
-        return self._issue(tree, op, group, scatter=True)
+        :meth:`all_reduce`.  ``error_feedback`` as in :meth:`all_reduce`
+        (this is how ``ZeroOptimizer`` keeps its shard-shaped residual)."""
+        return self._issue(tree, op, group, scatter=True,
+                           error_feedback=error_feedback)
 
-    def _issue(self, tree, op: str, group, scatter: bool) -> BucketWork:
+    def _issue(self, tree, op: str, group, scatter: bool,
+               error_feedback=None) -> BucketWork:
         import jax
         from . import eager as _eager
         from .work import completed_work, engine_for
@@ -300,6 +314,11 @@ class Bucketer:
 
         engine = engine_for(self._dp)
         issue_seq = self._next_issue_seq() if pinned else -1
+        # the wire format is resolved AT ISSUE (env is launcher-level and
+        # uniform, so issue-time == execute-time for every rank) — the
+        # error-feedback residual needs it to decide whether a residual
+        # exists at all
+        comm_spec = self._comm_dtype if pinned else _eager._comm_dtype()
         works, plans = [], []
         for bi, bucket in enumerate(buckets):
             # pack HERE, on the caller's thread: the flat bucket is a
@@ -308,9 +327,12 @@ class Bucketer:
             # engine thread would race such mutations and silently
             # diverge ranks that packed at different times)
             packed = bucket.pack(n)
+            residuals = self._bucket_residuals(bucket, bi, packed, n, r,
+                                               error_feedback, comm_spec,
+                                               scatter)
             works.append(engine.submit(
                 self._bucket_body(packed, op, n, group, issue_seq, bi,
-                                  scatter),
+                                  scatter, comm_spec, residuals),
                 label=f"{label}/bkt{bi}"))
             plans.append(("bucket", bucket))
         if rest_idx:
@@ -366,8 +388,38 @@ class Bucketer:
             self._seq += 1
             return s
 
+    @staticmethod
+    def _bucket_residuals(bucket, bi: int, packed, n: int, r: int,
+                          error_feedback, comm_spec, scatter: bool):
+        """Error-feedback residual(s) for one bucket, or None when no
+        residual loop is active.  The arrays live in the caller's
+        :class:`~tpu_dist.collectives.quant.ErrorFeedback` so they persist
+        across steps (bucket formation is deterministic per tree
+        structure, so bucket index ``bi`` is a stable key).
+
+        - all-reduce: ``("full", buf)`` — ONE full-bucket-layout residual
+          covering every per-hop partial-sum compression plus the owner
+          compression; the ring updates it in place.
+        - reduce-scatter: ``("leaves", [arrays])`` — per-member
+          owned-chunk residuals (the ZeRO-shard-resident form; possibly
+          views into ``zstate['ef']``), concatenated for the ring's
+          owner-compression hook and scattered back after."""
+        if error_feedback is None or comm_spec is None or n <= 1:
+            return None
+        buf, bucket_bounds, leaf_bounds = packed
+        dt = np.dtype(bucket.dtype)
+        if dt.kind not in "fV":  # lossy wire never applies to exact ints
+            return None
+        if not scatter:
+            return ("full", error_feedback.residual_for(
+                ("bucket", bi, dt.str), buf.size, dt))
+        return ("leaves",
+                [error_feedback.residual_for(idx, b[r][1] - b[r][0], dt)
+                 for idx, b in zip(bucket.indices, leaf_bounds)])
+
     def _bucket_body(self, packed, op: str, n: int, group,
-                     issue_seq: int, bi: int, scatter: bool = False):
+                     issue_seq: int, bi: int, scatter: bool = False,
+                     comm_spec=None, residuals=None):
         """The deferred per-bucket collective: ring all-reduce the
         (already-packed, issue-time-snapshotted) flat bucket with its
         per-leaf-aligned bounds, return ``(reduced_flat, leaf_bounds)`` —
@@ -383,7 +435,6 @@ class Bucketer:
             if self._dp is not None:
                 dp = self._dp
                 tag = f"bkt/i{issue_seq}/{bi}"
-                comm = self._comm_dtype
             else:
                 store = _eager._coll_store()
                 # sequence allocated HERE, in engine order — every rank
@@ -395,18 +446,40 @@ class Bucketer:
                 _eager._sanitize(op_name, group, store,
                                  value=buf, reduce_op=op)
                 dp = _eager._maybe_data_plane(group, store)
-                comm = _eager._comm_dtype()
+            residual = leaf_res = None
+            if residuals is not None:
+                kind, payload = residuals
+                if kind == "full":
+                    residual = payload  # ring updates it in place
+                else:
+                    leaf_res = payload
+                    residual = (payload[0] if len(payload) == 1
+                                else np.concatenate(
+                                    [np.asarray(a) for a in payload]))
             with _eager._obs_span(op_name, value=buf, reduce_op=op):
                 t0 = time.perf_counter()
+                stats: dict = {}
                 if scatter:
                     reduced = _ring.ring_reduce_scatter(
-                        dp, buf, op=op, tag=tag, comm_dtype=comm,
-                        bounds=bucket_bounds)
+                        dp, buf, op=op, tag=tag, comm_dtype=comm_spec,
+                        bounds=bucket_bounds, quant_residual=residual,
+                        stats=stats)
                 else:
-                    reduced = _ring.ring_all_reduce(dp, buf, op=op, tag=tag,
-                                                    comm_dtype=comm,
-                                                    bounds=bucket_bounds)
-                _eager._record(op_name, "dataplane", buf.nbytes, t0)
+                    reduced = _ring.ring_all_reduce(
+                        dp, buf, op=op, tag=tag, comm_dtype=comm_spec,
+                        bounds=bucket_bounds, quant_residual=residual,
+                        stats=stats)
+                _eager._record(op_name, "dataplane", buf.nbytes, t0,
+                               wire_bytes=stats.get("wire_bytes"),
+                               raw_wire_bytes=stats.get("raw_wire_bytes"))
+            if leaf_res is not None and len(leaf_res) > 1:
+                # scatter the ring-updated concat back into the per-leaf
+                # ErrorFeedback arrays (single-member buckets updated the
+                # leaf's array in place already)
+                pos = 0
+                for a in leaf_res:
+                    a[...] = residual[pos:pos + a.size]
+                    pos += a.size
             return reduced, leaf_bounds
 
         return body

@@ -167,6 +167,11 @@ def _sanitize(op: str, group, store=None, **fields) -> None:
     if store is None or group.num_processes <= 1:
         return
     from ..analysis import sanitizer
+    # every signature carries the configured wire-compression scheme: two
+    # ranks disagreeing on TPU_DIST_COMM_DTYPE would exchange frames in
+    # different formats and corrupt (or wedge) the ring — the sanitizer
+    # turns that into a CollectiveMismatchError naming both schemes
+    fields.setdefault("comm", _comm_name())
     sanitizer.check_collective(group, store, op, **fields)
 
 
@@ -231,9 +236,13 @@ def _routed_all_reduce(x, group, store, op, fn):
     comm = _comm_dtype()
     for j, i in enumerate(ring_idx):
         t0 = time.perf_counter()
+        stats: dict = {}
         out[i] = _ring.ring_all_reduce(dp, arrs[i], op=opl,
-                                       tag=f"{base}/{j}", comm_dtype=comm)
-        _record("all_reduce", "dataplane", arrs[i].nbytes, t0)
+                                       tag=f"{base}/{j}", comm_dtype=comm,
+                                       stats=stats)
+        _record("all_reduce", "dataplane", arrs[i].nbytes, t0,
+                wire_bytes=stats.get("wire_bytes"),
+                raw_wire_bytes=stats.get("raw_wire_bytes"))
     return jax.tree.unflatten(treedef, out)
 
 
@@ -283,10 +292,16 @@ def _routed_all_gather(x, group, store):
         for pos, i in enumerate(small):
             out[i] = np.stack([np.asarray(rows[r][pos]) for r in range(n)])
         _record("all_gather", "store", sum(arrs[i].nbytes for i in small), t0)
+    comm = _gather_comm_dtype()  # NOT the reduce knob: gathers are often
+    # exact-value exchanges, so lossy gather wire is its own opt-in
     for j, i in enumerate(ring_idx):
         t0 = time.perf_counter()
-        out[i] = _ring.ring_all_gather(dp, arrs[i], tag=f"{base}/{j}")
-        _record("all_gather", "dataplane", arrs[i].nbytes, t0)
+        stats: dict = {}
+        out[i] = _ring.ring_all_gather(dp, arrs[i], tag=f"{base}/{j}",
+                                       comm_dtype=comm, stats=stats)
+        _record("all_gather", "dataplane", arrs[i].nbytes, t0,
+                wire_bytes=stats.get("wire_bytes"),
+                raw_wire_bytes=stats.get("raw_wire_bytes"))
     return jax.tree.unflatten(treedef, out)
 
 
@@ -454,13 +469,44 @@ def _dp_threshold() -> int:
 
 
 def _comm_dtype():
-    """Optional wire-compression dtype for ring collectives
-    (``TPU_DIST_COMM_DTYPE=bfloat16`` etc.; EQuARX-style lossy wire)."""
+    """Optional wire compression for ring collectives
+    (``TPU_DIST_COMM_DTYPE``): a dtype name (``bfloat16`` — cast wire) or
+    a block-quantization scheme (``int8_block256`` — int8 payload +
+    per-block f32 scales, EQuARX-style; tpu_dist/collectives/quant.py).
+    Launcher-level env, so every rank resolves the same wire format."""
     name = os.environ.get("TPU_DIST_COMM_DTYPE", "").strip()
     if not name:
         return None
-    from .transport import _decode_dtype
-    return _decode_dtype(name)
+    from . import quant as _quant
+    return _quant.resolve_wire(name)
+
+
+def _gather_comm_dtype():
+    """Wire compression for the eager routed ALL-GATHER, its own explicit
+    opt-in (``TPU_DIST_COMM_DTYPE_GATHER``): reductions tolerate a lossy
+    wire (the values are statistical sums, and error feedback recovers
+    the loss), but gathered values are often exact-value exchanges —
+    parameter snapshots, metrics — so the reduce knob must never make
+    them lossy implicitly."""
+    name = os.environ.get("TPU_DIST_COMM_DTYPE_GATHER", "").strip()
+    if not name:
+        return None
+    from . import quant as _quant
+    return _quant.resolve_wire(name)
+
+
+def _comm_name() -> Optional[str]:
+    """Canonical spec string of the configured wire format(s) — what the
+    sanitizer signs, so mismatched compression configs fail loudly naming
+    both schemes instead of silently corrupting the ring.  Covers the
+    gather knob too: ranks disagreeing only on the gather wire would
+    still mis-decode each other's frames."""
+    from . import quant as _quant
+    reduce_spec = _quant.wire_name(_comm_dtype())
+    gather_spec = _quant.wire_name(_gather_comm_dtype())
+    if gather_spec is None:
+        return reduce_spec
+    return f"{reduce_spec or 'f32'}+gather:{gather_spec}"
 
 
 def _maybe_data_plane(group, store):
@@ -556,11 +602,17 @@ def _partition_and_dp(arrs, group, store, reduce_op=None):
     return sorted(big), [i for i in range(len(arrs)) if i not in big], dp
 
 
-def _record(op: str, path: str, nbytes: int, t0: float) -> None:
+def _record(op: str, path: str, nbytes: int, t0: float,
+            wire_bytes=None, raw_wire_bytes=None) -> None:
     # single ingestion point: feeds the per-(op, transport) counters AND
-    # stamps the enclosing flight-recorder span with the path taken
+    # stamps the enclosing flight-recorder span with the path taken.
+    # wire_bytes = compressed bytes actually sent, raw_wire_bytes = the
+    # same traffic uncompressed (quant/cast wire), so counters expose
+    # effective MB/s AND the wire-format compression ratio separately
     from ..obs import recorder as _obs
-    _obs.record_transport(op, path, nbytes, time.perf_counter() - t0)
+    _obs.record_transport(op, path, nbytes, time.perf_counter() - t0,
+                          wire_bytes=wire_bytes,
+                          raw_wire_bytes=raw_wire_bytes)
 
 
 def _obs_span(op: str, value=None, reduce_op=None, src=None, dst=None,

@@ -36,6 +36,16 @@ are cast to a narrower dtype on the wire and re-widened for accumulation.
 After the reduce-scatter the owning rank re-quantizes its fully-reduced
 chunk through the wire dtype, so the value every rank ends up holding is
 bit-identical — lossy vs. full precision, but consistent across the group.
+Beyond dtype casts, ``comm_dtype`` also accepts a **block-quantization
+scheme** (``"int8_block256"``, tpu_dist/collectives/quant.py): frames carry
+int8 payload + one f32 scale per block (~3.9× fewer wire bytes than f32).
+Reduce-scatter hops quantize the partial sums fresh each step; the
+all-gather phase forwards the owner's quantized frames **verbatim** hop to
+hop, so cross-rank byte-identity never depends on re-quantization being a
+float-rounding fixed point.  An optional error-feedback residual
+(``quant_residual``; :class:`~tpu_dist.collectives.quant.ErrorFeedback`
+at the bucketer/ZeRO level) folds the owner's compression loss back into
+the next step's chunk — the 1-bit Adam server-error discipline.
 
 These functions take a :class:`~tpu_dist.collectives.transport.DataPlane`
 directly (rank/world come from it), so they are usable from any process
@@ -52,6 +62,8 @@ import os
 from typing import Optional, Tuple
 
 import numpy as np
+
+from . import quant as _Q
 
 __all__ = ["ring_all_reduce", "ring_all_gather", "ring_reduce_scatter",
            "ring_chunk_all_gather", "tree_broadcast", "ring_chunk_span",
@@ -135,30 +147,63 @@ def _out_dtype(dtype: np.dtype, op: str) -> np.dtype:
     return dtype
 
 
+def _resolve_wire(comm_dtype, acc_dtype: np.dtype, float_only: bool = False):
+    """Resolve a ``comm_dtype`` spec (None / dtype / quant-scheme string)
+    against the accumulation dtype.  A quant scheme applies only to float
+    accumulators (f32/f64) — quantizing integer payloads would silently
+    change exact arithmetic.  ``float_only`` extends that gate to cast
+    wires too (the gather paths: their payloads may be raw bytes — padded
+    pickle frames from the object collectives — that a lossy cast would
+    corrupt).  The gate depends only on dtype, so every rank answers
+    identically."""
+    wire = _Q.resolve_wire(comm_dtype)
+    if wire is None:
+        return None
+    dt = np.dtype(acc_dtype)
+    # float = numpy floats AND the ml_dtypes family (bfloat16/float8
+    # register as unstructured void, kind 'V'), same recognition the
+    # bucketer and routing gates use
+    is_float = dt.kind == "f" or (dt.kind == "V" and dt.fields is None)
+    if isinstance(wire, _Q.QuantScheme):
+        return wire if is_float else None
+    return wire if (is_float or not float_only) else None
+
+
 def _send_span(dp, dst: int, tag: str, flat: np.ndarray, lo: int, hi: int,
-               wire_dtype: Optional[np.dtype]) -> None:
-    """Send flat[lo:hi] as sub-chunk frames."""
+               wire_dtype: Optional[np.dtype]) -> int:
+    """Send flat[lo:hi] as sub-chunk frames; returns wire bytes sent."""
     if hi <= lo:
-        return
+        return 0
     step = max(1, _chunk_bytes() // flat.itemsize)
+    wb = 0
     for slo in range(lo, hi, step):
         seg = flat[slo:min(slo + step, hi)]
+        if isinstance(wire_dtype, _Q.QuantScheme):
+            q, s = _Q.quantize(seg, wire_dtype)
+            wb += dp.send_quant(dst, tag, _Q.QuantChunk(q, s, wire_dtype))
+            continue
         if wire_dtype is not None and seg.dtype != wire_dtype:
             seg = seg.astype(wire_dtype)
-        dp.send_array(dst, tag, seg)
+        wb += dp.send_array(dst, tag, seg)
+    return wb
 
 
-def _fold(flat: np.ndarray, seg: np.ndarray, pos: int, hi: int, tag: str,
+def _fold(flat: np.ndarray, seg, pos: int, hi: int, tag: str,
           combine) -> int:
     """Fold one arriving frame into ``flat[pos:pos+len]``; returns the new
     position.  ``combine`` is a ufunc (reduce-scatter) or None (overwrite,
-    all-gather); frames in a narrower wire dtype widen here."""
+    all-gather); frames in a narrower wire dtype widen here, quantized
+    frames (:class:`~tpu_dist.collectives.quant.QuantChunk`) dequantize
+    here."""
     m = seg.size
     if pos + m > hi:
         raise RuntimeError(
             f"ring frame overrun: got {m} elements at {pos} with only "
             f"{hi - pos} expected (tag {tag!r})")
-    part = seg if seg.dtype == flat.dtype else seg.astype(flat.dtype)
+    if isinstance(seg, _Q.QuantChunk):
+        part = seg.dequantize(flat.dtype)
+    else:
+        part = seg if seg.dtype == flat.dtype else seg.astype(flat.dtype)
     if combine is None:
         flat[pos:pos + m] = part
     else:
@@ -179,25 +224,49 @@ def _recv_span(dp, src: int, tag: str, flat: np.ndarray, lo: int, hi: int,
 
 def _exchange(dp, right: int, left: int, tag: str, flat: np.ndarray,
               send_lo: int, send_hi: int, recv_lo: int, recv_hi: int,
-              combine, wire_dtype: Optional[np.dtype]) -> None:
+              combine, wire_dtype, residual=None) -> int:
     """One double-buffered ring step: send ``flat[send_lo:send_hi]`` to
     ``right`` as sub-chunk frames while folding the frames arriving from
-    ``left`` into ``flat[recv_lo:recv_hi]``.
+    ``left`` into ``flat[recv_lo:recv_hi]``.  Returns wire bytes sent.
 
     The send of sub-chunk *j+1* overlaps the fold of sub-chunk *i*: after
     every send the loop drains (non-blocking) whatever the transport's
     reader thread already queued, so CPU reduce time hides behind the wire
     and vice versa.  Only frames that genuinely have not arrived when the
-    sends are done cost a blocking wait."""
+    sends are done cost a blocking wait.
+
+    Under a quant scheme the outgoing segments (reduce-scatter partial
+    sums) are block-quantized fresh for each hop — their values change as
+    contributions fold in, so there is nothing to forward verbatim; the
+    verbatim-forwarding discipline belongs to the all-gather phase
+    (:func:`_ag_phase_quant`).  ``residual`` (full-payload error-feedback
+    buffer, indexed like ``flat``) compensates exactly this per-hop loss:
+    each outgoing segment sends ``compress(seg + residual)`` and keeps the
+    new loss for the next step."""
     step = max(1, _chunk_bytes() // flat.itemsize)
     sp, rp = send_lo, recv_lo
+    wb = 0
     while sp < send_hi:
         nxt = min(sp + step, send_hi)
         seg = flat[sp:nxt]
+        res = residual[sp:nxt] if residual is not None else None
         sp = nxt
-        if wire_dtype is not None and seg.dtype != wire_dtype:
-            seg = seg.astype(wire_dtype)
-        dp.send_array(right, tag, seg)
+        if res is not None and res.size:
+            seg = seg + np.asarray(res).astype(seg.dtype)
+        if isinstance(wire_dtype, _Q.QuantScheme):
+            q, s = _Q.quantize(seg, wire_dtype)
+            if res is not None and res.size:
+                _store_residual(
+                    res, seg - _Q.dequantize(q, s, wire_dtype, seg.dtype))
+            wb += dp.send_quant(right, tag, _Q.QuantChunk(q, s, wire_dtype))
+        else:
+            sent = seg
+            if wire_dtype is not None and seg.dtype != wire_dtype:
+                sent = seg.astype(wire_dtype)
+            if res is not None and res.size and wire_dtype is not None \
+                    and seg.dtype != wire_dtype:
+                _store_residual(res, seg - sent.astype(seg.dtype))
+            wb += dp.send_array(right, tag, sent)
         while rp < recv_hi:
             got = dp.try_recv_array(left, tag)
             if got is None:
@@ -207,6 +276,7 @@ def _exchange(dp, right: int, left: int, tag: str, flat: np.ndarray,
         # tpudlint: disable=TD004  # recv_array applies TPU_DIST_DP_TIMEOUT
         rp = _fold(flat, dp.recv_array(left, tag), rp, recv_hi, tag,
                    combine)
+    return wb
 
 
 def _obs_span(op: str, value):
@@ -228,35 +298,170 @@ def _prepare(dp, x, op: str):
 
 
 def _reduce_scatter_phase(dp, flat, bounds, n, r, op, tag,
-                          wire_dtype) -> None:
+                          wire_dtype, residual=None) -> int:
     """N-1 double-buffered ring steps; afterwards this rank's own chunk
     ``bounds[r]`` holds the full reduction.  Schedule is the textbook one
     shifted so rank r ends up owning chunk r (send chunk (r-1-step),
     absorb (r-2-step)); within each step send and fold interleave
-    (:func:`_exchange`)."""
+    (:func:`_exchange`).  Returns wire bytes sent.  ``residual`` is the
+    full-payload per-hop error-feedback buffer (each chunk except this
+    rank's own is sent exactly once, so every span is used once per
+    call)."""
     comb = _combine(op)
     right, left = (r + 1) % n, (r - 1) % n
     rp = (r - 1) % n
+    wb = 0
     for step in range(n - 1):
         si = (rp - step) % n
         ri = (rp - step - 1) % n
-        _exchange(dp, right, left, tag, flat, *bounds[si], *bounds[ri],
-                  combine=comb, wire_dtype=wire_dtype)
+        wb += _exchange(dp, right, left, tag, flat, *bounds[si],
+                        *bounds[ri], combine=comb, wire_dtype=wire_dtype,
+                        residual=residual)
+    return wb
 
 
-def _all_gather_phase(dp, flat, bounds, n, r, tag, wire_dtype) -> None:
+def _all_gather_phase(dp, flat, bounds, n, r, tag, wire_dtype) -> int:
     """N-1 double-buffered ring steps circulating the fully-reduced chunks
-    (rank r starts owning chunk r)."""
+    (rank r starts owning chunk r).  Returns wire bytes sent.  Quant
+    schemes take :func:`_ag_phase_quant` instead (verbatim frame
+    forwarding)."""
     right, left = (r + 1) % n, (r - 1) % n
+    wb = 0
     for step in range(n - 1):
         si = (r - step) % n
         ri = (r - step - 1) % n
-        _exchange(dp, right, left, tag, flat, *bounds[si], *bounds[ri],
-                  combine=None, wire_dtype=wire_dtype)
+        wb += _exchange(dp, right, left, tag, flat, *bounds[si],
+                        *bounds[ri], combine=None, wire_dtype=wire_dtype)
+    return wb
+
+
+def _store_residual(residual, diff) -> None:
+    """Update an error-feedback residual with this step's compression
+    loss, dropping non-finite entries: a transient inf/nan gradient
+    poisons THIS step's output loudly (the quant NaN-block policy), but
+    must not lodge NaN in the residual and re-inject it forever — the
+    poison stays one step, the residual restarts from zero there."""
+    diff = np.asarray(diff)
+    finite = np.isfinite(diff.astype(np.float32, copy=False))
+    if not finite.all():
+        diff = np.where(finite, diff, 0)
+    residual[...] = diff.astype(residual.dtype)
+
+
+def _compress_owned(chunk: np.ndarray, wire, residual):
+    """Round this rank's fully-reduced owned chunk through the wire format
+    — the value every peer will receive — optionally folding in and
+    updating an error-feedback residual (the owner adds last step's
+    compression loss before compressing, then keeps the new loss).
+
+    Returns ``(values, qframes)``: the wire-faithful replacement values in
+    the chunk's dtype, plus the exact ``(q, scales)`` pair to forward
+    (quant schemes only, else None)."""
+    if chunk.size == 0:
+        return chunk, None
+    if residual is not None and residual.size:
+        chunk = chunk + np.asarray(residual).astype(chunk.dtype)
+    if isinstance(wire, _Q.QuantScheme):
+        q, s = _Q.quantize(chunk, wire)
+        deq = _Q.dequantize(q, s, wire, dtype=chunk.dtype)
+        frames = (q, s)
+    else:
+        deq = chunk.astype(wire).astype(chunk.dtype)
+        frames = None
+    if residual is not None and residual.size:
+        _store_residual(residual, chunk - deq)
+    return deq, frames
+
+
+def _split_quant(q: np.ndarray, scales: np.ndarray, scheme):
+    """Split one whole-chunk quantization into wire frames at
+    block-aligned boundaries, so each frame carries exactly its own
+    scales.  Frame size tracks ``TPU_DIST_DP_CHUNK`` (the wire payload is
+    ~1 byte per element)."""
+    n = q.size
+    step = max(scheme.block,
+               _chunk_bytes() - _chunk_bytes() % scheme.block)
+    frames = []
+    for flo in range(0, n, step):
+        fhi = min(flo + step, n)
+        frames.append(_Q.QuantChunk(
+            q[flo:fhi],
+            scales[flo // scheme.block:scheme.scales_for(fhi)], scheme))
+    return frames
+
+
+def _land_quant(flat, got, pos: int, hi: int, tag: str, incoming) -> int:
+    """All-gather-phase landing of one quantized frame: dequantize into
+    ``flat`` AND keep the frame for verbatim forwarding next step."""
+    if not isinstance(got, _Q.QuantChunk):
+        raise RuntimeError(
+            f"quantized ring expected a q8 frame on tag {tag!r}, got a "
+            f"plain {getattr(got, 'dtype', type(got).__name__)} frame — "
+            f"ranks disagree on the comm scheme")
+    m = got.size
+    if pos + m > hi:
+        raise RuntimeError(
+            f"ring frame overrun: got {m} elements at {pos} with only "
+            f"{hi - pos} expected (tag {tag!r})")
+    flat[pos:pos + m] = got.dequantize(flat.dtype)
+    incoming.append(got)
+    return pos + m
+
+
+def _ag_phase_quant(dp, flat, bounds, n, r, tag, scheme,
+                    residual=None) -> int:
+    """All-gather phase under a quant scheme: the owner compresses its
+    chunk ONCE (folding in the error-feedback residual, replacing its own
+    span with the dequantized values every peer will hold), then the
+    quantized frames circulate **verbatim** — each rank forwards exactly
+    the bytes it received, so all N ranks reconstruct every chunk from
+    identical frames.  Returns wire bytes sent."""
+    right, left = (r + 1) % n, (r - 1) % n
+    lo, hi = bounds[r]
+    chunk = np.array(flat[lo:hi])  # standalone: _compress_owned re-binds
+    deq, qs = _compress_owned(chunk, scheme, residual)
+    flat[lo:hi] = deq
+    frames = _split_quant(*qs, scheme) if qs is not None else []
+    wb = 0
+    for step in range(n - 1):
+        ri = (r - step - 1) % n
+        rlo, rhi = bounds[ri]
+        incoming: list = []
+        pos = rlo
+        for fr in frames:
+            wb += dp.send_quant(right, tag, fr)
+            while pos < rhi:
+                got = dp.try_recv_array(left, tag)
+                if got is None:
+                    break
+                pos = _land_quant(flat, got, pos, rhi, tag, incoming)
+        while pos < rhi:
+            # tpudlint: disable=TD004  # recv_array applies TPU_DIST_DP_TIMEOUT
+            pos = _land_quant(flat, dp.recv_array(left, tag), pos, rhi,
+                              tag, incoming)
+        frames = incoming
+    return wb
+
+
+def _note_stats(stats, wire, wire_bytes: int, raw_bytes: int) -> None:
+    """Fill the caller's ``stats`` dict and stamp the enclosing obs span
+    with the wire quantities: ``wire_bytes`` = what actually crossed the
+    wire (compressed), ``raw_wire_bytes`` = what the SAME traffic would
+    have cost uncompressed — their ratio is the wire-format compression
+    factor, independent of the ring's 2(N-1)/N amplification over the
+    logical payload (which the span's ``bytes`` field still shows)."""
+    name = _Q.wire_name(wire)
+    if stats is not None:
+        stats["wire_bytes"] = int(wire_bytes)
+        stats["raw_wire_bytes"] = int(raw_bytes)
+        stats["comm"] = name
+    from ..obs import hooks as _hooks
+    _hooks.note_wire(int(wire_bytes), name, raw_bytes=int(raw_bytes))
 
 
 def ring_all_reduce(dp, x, op: str = "sum", tag: str = "ar",
-                    comm_dtype=None, bounds=None) -> np.ndarray:
+                    comm_dtype=None, bounds=None, quant_residual=None,
+                    stats=None) -> np.ndarray:
     """Bandwidth-optimal ring all-reduce of ``x`` across the group.
 
     reduce-scatter + all-gather, 2(N-1)/N of the payload on the wire per
@@ -270,32 +475,81 @@ def ring_all_reduce(dp, x, op: str = "sum", tag: str = "ar",
     spans covering the flat payload, identical on every rank): the
     bucketer aligns bucket chunks with per-leaf chunks this way so that
     bucketed and per-leaf reductions share fold order bit-for-bit.
-    """
+
+    ``comm_dtype`` accepts a dtype (cast wire) or a quant scheme spec
+    (``"int8_block256"``); ``quant_residual`` is this rank's
+    error-feedback buffer, updated in place with the new compression
+    losses — either **full-payload-sized** (per-hop residuals for every
+    outgoing partial sum, plus the owner compression: the strong EF the
+    bucketer's all-reduce uses) or **owned-chunk-sized** (length
+    ``bounds[rank]``, owner compression only: the ZeRO-shard-resident
+    form).  ``stats`` (a dict) receives ``wire_bytes`` and ``comm`` — the
+    compressed wire quantity, vs. the logical payload."""
     x, op, n, r, flat = _prepare(dp, x, op)
     _combine(op)  # raise on an unsupported op before any traffic
     out_dtype = _out_dtype(x.dtype, op)
     if n <= 1:
         return flat.astype(out_dtype).reshape(x.shape)
-    wire = np.dtype(comm_dtype) if comm_dtype is not None else None
+    wire = _resolve_wire(comm_dtype, flat.dtype)
     if flat.size == 0:
         return flat.astype(out_dtype).reshape(x.shape)
     if bounds is None:
         bounds = _bounds(flat.size, n)
     else:
         bounds = _check_bounds(bounds, n, flat.size)
+    res_full, res_own = _split_residual(quant_residual, wire, flat.size,
+                                        bounds[r])
     utag = f"{tag}/rar"
     with _obs_span("ring_all_reduce", x):
-        _reduce_scatter_phase(dp, flat, bounds, n, r, op, utag, wire)
+        wb = _reduce_scatter_phase(dp, flat, bounds, n, r, op, utag, wire,
+                                   residual=res_full)
         lo, hi = bounds[r]
         if op in ("avg", "mean"):
             flat[lo:hi] = flat[lo:hi] / n
-        if wire is not None:
-            # re-quantize the owned chunk through the wire dtype so the
-            # values this rank keeps match the compressed copies every peer
-            # receives
-            flat[lo:hi] = flat[lo:hi].astype(wire).astype(flat.dtype)
-        _all_gather_phase(dp, flat, bounds, n, r, utag, wire)
+        if isinstance(wire, _Q.QuantScheme):
+            # owner compression + verbatim frame circulation (quant.py's
+            # byte-identity discipline)
+            wb += _ag_phase_quant(dp, flat, bounds, n, r, utag, wire,
+                                  residual=res_own)
+        else:
+            if wire is not None:
+                # re-quantize the owned chunk through the wire dtype so
+                # the values this rank keeps match the compressed copies
+                # every peer receives
+                deq, _ = _compress_owned(np.array(flat[lo:hi]), wire,
+                                         res_own)
+                flat[lo:hi] = deq
+            wb += _all_gather_phase(dp, flat, bounds, n, r, utag, wire)
+        # uncompressed-equivalent of the same traffic: this rank sends
+        # every chunk but its own in the RS phase and every chunk but its
+        # right neighbor's in the AG phase
+        raw = ((2 * flat.size - (hi - lo)
+                - _span_len(bounds, (r + 1) % n)) * flat.itemsize)
+        _note_stats(stats, wire, wb, raw)
     return flat.astype(out_dtype, copy=False).reshape(x.shape)
+
+
+def _span_len(bounds, i: int) -> int:
+    lo, hi = bounds[i]
+    return hi - lo
+
+
+def _split_residual(quant_residual, wire, size: int, own_span):
+    """Dispatch an error-feedback buffer by its length: full-payload
+    (per-hop + owner residuals; the owner part is a view into it) or
+    owned-chunk (owner compression only).  None when no lossy wire is in
+    play — the residual must not drift while compression is off."""
+    if quant_residual is None or wire is None:
+        return None, None
+    lo, hi = own_span
+    res = quant_residual
+    if res.size == size:
+        return res, res[lo:hi]
+    if res.size == hi - lo:
+        return None, res
+    raise ValueError(
+        f"quant_residual must be full-payload ({size}) or owned-chunk "
+        f"({hi - lo}) sized, got {res.size}")
 
 
 def _check_bounds(bounds, n: int, size: int):
@@ -309,7 +563,8 @@ def _check_bounds(bounds, n: int, size: int):
 
 
 def ring_reduce_scatter(dp, x, op: str = "sum", tag: str = "rs",
-                        comm_dtype=None, bounds=None) -> np.ndarray:
+                        comm_dtype=None, bounds=None, quant_residual=None,
+                        stats=None) -> np.ndarray:
     """Reduce-scatter phase alone: returns this rank's fully-reduced chunk
     (flat 1-D; its span is :func:`ring_chunk_span`, or ``bounds[rank]`` when
     a custom chunk partition is passed).  Uneven payloads give the first
@@ -327,30 +582,38 @@ def ring_reduce_scatter(dp, x, op: str = "sum", tag: str = "rs",
     out_dtype = _out_dtype(x.dtype, op)
     if n <= 1:
         return flat.astype(out_dtype)
-    wire = np.dtype(comm_dtype) if comm_dtype is not None else None
+    wire = _resolve_wire(comm_dtype, flat.dtype)
     if bounds is None:
         bounds = _bounds(flat.size, n)
     else:
         bounds = _check_bounds(bounds, n, flat.size)
+    res_full, res_own = _split_residual(quant_residual, wire, flat.size,
+                                        bounds[r])
+    wb = 0
     if flat.size:
         with _obs_span("ring_reduce_scatter", x):
-            _reduce_scatter_phase(dp, flat, bounds, n, r, op, f"{tag}/rrs",
-                                  wire)
+            wb = _reduce_scatter_phase(dp, flat, bounds, n, r,
+                                       op, f"{tag}/rrs", wire,
+                                       residual=res_full)
+            _note_stats(stats, wire, wb,
+                        (flat.size - _span_len(bounds, r)) * flat.itemsize)
     lo, hi = bounds[r]
     chunk = flat[lo:hi]
     if op in ("avg", "mean"):
         chunk = chunk / n
     if wire is not None:
-        # owner re-quantization, exactly as ring_all_reduce performs before
+        # owner compression, exactly as ring_all_reduce performs before
         # its all-gather phase: the shard this rank keeps must equal the
-        # compressed bytes every peer would have received
-        chunk = chunk.astype(wire).astype(flat.dtype)
+        # compressed bytes every peer would have received (error-feedback
+        # residual folded in / updated at the same point)
+        chunk, _ = _compress_owned(np.array(chunk), wire, res_own)
     # copy: the slice would otherwise pin the whole widened accumulation
     # buffer alive for the lifetime of the (small) shard
     return np.array(chunk.astype(out_dtype, copy=False))
 
 
-def ring_chunk_all_gather(dp, flat, bounds, tag: str = "cag") -> np.ndarray:
+def ring_chunk_all_gather(dp, flat, bounds, tag: str = "cag",
+                          comm_dtype=None, stats=None) -> np.ndarray:
     """All-gather of pre-owned chunks — the all-gather phase of the ring
     alone, the inverse of :func:`ring_reduce_scatter`'s stop.
 
@@ -359,7 +622,14 @@ def ring_chunk_all_gather(dp, flat, bounds, tag: str = "cag") -> np.ndarray:
     N-1 double-buffered ring steps every span holds its owner's bytes —
     identical on every rank.  Fills ``flat`` in place and returns it.
     This is how a ZeRO optimizer redistributes updated parameter shards
-    (tpu_dist/parallel/zero.py)."""
+    (tpu_dist/parallel/zero.py).
+
+    ``comm_dtype`` (dtype or quant scheme) compresses the gathered chunks
+    on the wire — **lossy**: every rank, including the owner, ends up with
+    the chunk rounded through the wire format (the owner replaces its own
+    span first, so the result stays byte-identical across ranks).  Leave
+    it None when the gathered values are parameters that must stay
+    exact."""
     flat = np.asarray(flat)
     if flat.ndim != 1:
         raise ValueError(f"ring_chunk_all_gather wants a flat 1-D buffer, "
@@ -368,16 +638,34 @@ def ring_chunk_all_gather(dp, flat, bounds, tag: str = "cag") -> np.ndarray:
     if n <= 1 or flat.size == 0:
         return flat
     bounds = _check_bounds(bounds, n, flat.size)
+    wire = _resolve_wire(comm_dtype, flat.dtype, float_only=True)
     with _obs_span("ring_chunk_all_gather", flat):
-        _all_gather_phase(dp, flat, bounds, n, r, f"{tag}/rcag",
-                          wire_dtype=None)
+        if isinstance(wire, _Q.QuantScheme):
+            wb = _ag_phase_quant(dp, flat, bounds, n, r, f"{tag}/rcag",
+                                 wire)
+        else:
+            if wire is not None:
+                lo, hi = bounds[r]
+                deq, _ = _compress_owned(np.array(flat[lo:hi]), wire, None)
+                flat[lo:hi] = deq
+            wb = _all_gather_phase(dp, flat, bounds, n, r, f"{tag}/rcag",
+                                   wire_dtype=wire)
+        _note_stats(stats, wire, wb,
+                    (flat.size - _span_len(bounds, (r + 1) % n))
+                    * flat.itemsize)
     return flat
 
 
-def ring_all_gather(dp, x, tag: str = "ag") -> np.ndarray:
+def ring_all_gather(dp, x, tag: str = "ag", comm_dtype=None,
+                    stats=None) -> np.ndarray:
     """Ring all-gather: every rank contributes ``x`` (same shape/dtype on
     all ranks); returns an array with a leading process axis, blocks in
-    rank order — (N-1)/N of the output on the wire per rank."""
+    rank order — (N-1)/N of the output on the wire per rank.
+
+    ``comm_dtype`` (dtype or quant scheme) compresses the circulated
+    blocks — **lossy**: every rank's block, including its own copy in the
+    result, is rounded through the wire format at the source, so the
+    gathered array stays byte-identical across ranks."""
     x = np.asarray(x)
     n, r = dp.num_processes, dp.rank
     if n <= 1:
@@ -385,20 +673,26 @@ def ring_all_gather(dp, x, tag: str = "ag") -> np.ndarray:
     flat = np.ascontiguousarray(x).reshape(-1)
     out = np.empty((n, flat.size), dtype=x.dtype)
     out[r] = flat
-    right, left = (r + 1) % n, (r - 1) % n
     utag = f"{tag}/rag"
     # the (n, size) block matrix viewed flat so each step's send/recv rows
     # become spans of ONE buffer the double-buffered exchange can walk
     out_flat = out.reshape(-1)
     sz = flat.size
+    bounds = [(i * sz, (i + 1) * sz) for i in range(n)]
+    wire = _resolve_wire(comm_dtype, out.dtype, float_only=True)
     with _obs_span("ring_all_gather", x):
-        for step in range(n - 1):
-            si = (r - step) % n
-            ri = (r - step - 1) % n
-            if sz:
-                _exchange(dp, right, left, utag, out_flat,
-                          si * sz, (si + 1) * sz, ri * sz, (ri + 1) * sz,
-                          combine=None, wire_dtype=None)
+        wb = 0
+        if sz:
+            if isinstance(wire, _Q.QuantScheme):
+                wb = _ag_phase_quant(dp, out_flat, bounds, n, r, utag,
+                                     wire)
+            else:
+                if wire is not None:
+                    deq, _ = _compress_owned(np.array(out[r]), wire, None)
+                    out[r] = deq
+                wb = _all_gather_phase(dp, out_flat, bounds, n, r, utag,
+                                       wire_dtype=wire)
+        _note_stats(stats, wire, wb, sz * (n - 1) * out.itemsize)
     return out.reshape((n,) + x.shape)
 
 

@@ -116,25 +116,31 @@ def _tune_socket(sock) -> Tuple[int, int]:
         return (0, 0)
 
 
-def _sendv(sock, header: bytes, payload) -> None:
-    """Vectored send: header + payload leave in one ``sendmsg`` syscall —
-    no concat copy of the payload, no separate header segment on the wire.
-    Falls back to two ``sendall`` calls where ``sendmsg`` is missing."""
-    if not len(payload):
+def _sendv(sock, header: bytes, *payloads) -> None:
+    """Vectored send: header + every payload part leave in one ``sendmsg``
+    syscall — no concat copy of the payloads, no separate header segment
+    on the wire.  Quantized frames pass two parts (scales, int8 payload);
+    plain frames one.  Falls back to sequential ``sendall`` where
+    ``sendmsg`` is missing."""
+    parts = [memoryview(header)]
+    parts.extend(memoryview(p).cast("B") for p in payloads if len(p))
+    if len(parts) == 1:
         sock.sendall(header)
         return
     if not hasattr(sock, "sendmsg"):
-        sock.sendall(header)
-        sock.sendall(payload)
+        for p in parts:
+            sock.sendall(p)
         return
-    hlen, plen = len(header), len(payload)
-    total = hlen + plen
-    sent = sock.sendmsg([header, payload])
-    while sent < total:  # partial vectored send: resume across both parts
-        if sent < hlen:
-            sent += sock.sendmsg([memoryview(header)[sent:], payload])
-        else:
-            sent += sock.send(payload[sent - hlen:])
+    total = sum(len(p) for p in parts)
+    done = 0
+    while done < total:  # partial vectored sends resume across the parts
+        n = sock.sendmsg(parts) if len(parts) > 1 else sock.send(parts[0])
+        done += n
+        while parts and n >= len(parts[0]):
+            n -= len(parts[0])
+            parts.pop(0)
+        if n and parts:
+            parts[0] = parts[0][n:]
 
 
 def _recv_exact(conn, n: int) -> Optional[bytearray]:
@@ -315,13 +321,16 @@ class DataPlane:
         (tlen,) = _U32.unpack(bytes(raw))
         tag = bytes(_recv_exact_or_raise(conn, tlen)).decode()
         (dlen,) = _U16.unpack(bytes(_recv_exact_or_raise(conn, _U16.size)))
-        dtype = _decode_dtype(bytes(_recv_exact_or_raise(conn, dlen)).decode())
+        dtype_name = bytes(_recv_exact_or_raise(conn, dlen)).decode()
         (ndim,) = _U8.unpack(bytes(_recv_exact_or_raise(conn, _U8.size)))
         shape = tuple(
             _U64.unpack(bytes(_recv_exact_or_raise(conn, _U64.size)))[0]
             for _ in range(ndim))
         (plen,) = _U64.unpack(bytes(_recv_exact_or_raise(conn, _U64.size)))
         payload = (_recv_exact_or_raise(conn, plen) if plen else bytearray())
+        if dtype_name.startswith("q8b"):
+            return tag, _decode_quant(dtype_name, shape, payload, plen)
+        dtype = _decode_dtype(dtype_name)
         # zero-copy: the ndarray wraps the receive buffer (writable, owned
         # by the frame) — no pickle, no second materialization
         arr = np.frombuffer(payload, dtype=dtype)
@@ -366,8 +375,6 @@ class DataPlane:
         Blocking, but never deadlocks against a peer doing the same: the
         peer's reader thread is always draining its socket.  Raises
         :class:`PeerGoneError` if the connection to ``dst`` fails."""
-        if dst == self.rank:
-            raise ValueError("data plane does not deliver to self")
         arr = np.asarray(arr)
         shape = arr.shape  # before ascontiguousarray, which flattens 0-d
         arr = np.ascontiguousarray(arr)
@@ -377,6 +384,31 @@ class DataPlane:
             payload = arr.tobytes()  # exotic dtypes without buffer support
         header = _encode_frame_header(
             tag.encode(), arr.dtype.name.encode(), shape, len(payload))
+        return self._send_frame(dst, header, (payload,))
+
+    def send_quant(self, dst: int, tag: str, chunk) -> int:
+        """Send one block-quantized frame (a
+        :class:`~tpu_dist.collectives.quant.QuantChunk`): int8 payload +
+        per-block float32 scales in ONE vectored ``sendmsg``, under the
+        wire dtype name ``q8b{block}``.  Returns wire payload bytes sent
+        (q + scales) — the compressed quantity obs counts as
+        ``wire_bytes``."""
+        scales = np.ascontiguousarray(chunk.scales, np.float32)
+        q = np.ascontiguousarray(chunk.q, np.int8)
+        plen = scales.nbytes + q.nbytes
+        header = _encode_frame_header(
+            tag.encode(), f"q8b{chunk.scheme.block}".encode(),
+            (q.size,), plen)
+        return self._send_frame(
+            dst, header,
+            (memoryview(scales).cast("B"), memoryview(q).cast("B")))
+
+    def _send_frame(self, dst: int, header: bytes, parts) -> int:
+        """Shared outbound path for plain and quantized frames: one
+        connection per destination, vectored send, peer death diagnosed
+        outside the send lock."""
+        if dst == self.rank:
+            raise ValueError("data plane does not deliver to self")
         send_err = None
         with self._out_lock(dst):
             sock = self._out.get(dst)
@@ -384,7 +416,7 @@ class DataPlane:
                 if sock is None:
                     sock = self._connect(dst)
                     self._out[dst] = sock
-                _sendv(sock, header, payload)
+                _sendv(sock, header, *parts)
             except PeerGoneError as e:
                 send_err = e  # _connect diagnosed the peer; the obs-tail
                 # enrichment still happens below, outside the lock
@@ -402,7 +434,7 @@ class DataPlane:
             detail = (send_err.detail if isinstance(send_err, PeerGoneError)
                       else repr(send_err))
             raise self.gone_error(dst, detail) from send_err
-        return len(payload)
+        return sum(len(p) for p in parts)
 
     # -- receive -------------------------------------------------------------
 
@@ -564,6 +596,30 @@ def _recv_exact_or_raise(conn, n: int) -> bytearray:
     if buf is None:
         raise ConnectionError("connection closed mid-frame")
     return buf
+
+
+def _decode_quant(dtype_name: str, shape, payload, plen: int):
+    """Decode one ``q8b{block}`` frame (scales || int8 payload) into a
+    :class:`~tpu_dist.collectives.quant.QuantChunk`.  Both arrays wrap the
+    receive buffer zero-copy; the ring dequantizes at the fold or forwards
+    the chunk verbatim."""
+    from .quant import QuantChunk, QuantScheme
+    try:
+        scheme = QuantScheme(int(dtype_name[3:]))
+    except ValueError as e:
+        raise ConnectionError(f"bad quant frame dtype {dtype_name!r}") from e
+    if len(shape) != 1:
+        raise ConnectionError(
+            f"quant frame wants flat shape, got {shape}")
+    n = int(shape[0])
+    sbytes = 4 * scheme.scales_for(n)
+    if plen != sbytes + n:
+        raise ConnectionError(
+            f"quant frame payload {plen}B does not match {n} elements at "
+            f"block {scheme.block} ({sbytes}B scales + {n}B q)")
+    view = memoryview(payload)
+    return QuantChunk(np.frombuffer(view[sbytes:], np.int8),
+                      np.frombuffer(view[:sbytes], np.float32), scheme)
 
 
 # -- process-wide singleton ---------------------------------------------------

@@ -145,6 +145,31 @@ def note_path(path: str) -> None:
             rec.update_event(span, path=path)
 
 
+def note_wire(wire_bytes: int, comm: Optional[str] = None,
+              raw_bytes: Optional[int] = None) -> None:
+    """Stamp the enclosing span with the wire quantities: ``wire_bytes``
+    (compressed bytes that actually crossed the wire), ``raw_wire_bytes``
+    (what the same traffic would have cost uncompressed — their ratio is
+    the wire-format compression factor, independent of the ring's
+    2(N-1)/N amplification), and the wire format (``comm``, e.g.
+    ``"int8_block256"`` / ``"bfloat16"`` / None for raw).  The span's
+    ``bytes`` field stays the logical payload.  Called by the host ring
+    collectives (tpu_dist/collectives/ring.py) at span close."""
+    span = current_span()
+    if span is None:
+        return
+    rec = recorder.get_recorder()
+    if rec is None:
+        return
+    fields = {"wire_bytes": int(span.get("wire_bytes", 0)) + int(wire_bytes)}
+    if raw_bytes is not None:
+        fields["raw_wire_bytes"] = (int(span.get("raw_wire_bytes", 0))
+                                    + int(raw_bytes))
+    if comm is not None:
+        fields["comm"] = comm
+    rec.update_event(span, **fields)
+
+
 def annotate_transport(rec, op: str, path: str, nbytes: int,
                        seconds: float) -> None:
     """Fold one transport leg into the enclosing span, or record it as a

@@ -363,19 +363,32 @@ _agg_mu = threading.Lock()
 _agg: Dict[str, Dict[str, float]] = {}
 
 
-def record_transport(op: str, path: str, nbytes: int,
-                     seconds: float) -> None:
+def record_transport(op: str, path: str, nbytes: int, seconds: float,
+                     wire_bytes: Optional[int] = None,
+                     raw_wire_bytes: Optional[int] = None) -> None:
     """Account one transport leg: ``op`` over ``path`` ('dataplane' |
-    'store' | 'mesh') moving ``nbytes`` in ``seconds``.  Always feeds the
-    aggregate counters; when armed it additionally annotates the enclosing
-    collective span (or records a standalone ``transport`` event)."""
+    'store' | 'mesh') moving ``nbytes`` *logical* bytes in ``seconds``.
+    ``wire_bytes`` is what actually crossed the wire (compressed when a
+    wire format was in play); ``raw_wire_bytes`` is what the SAME traffic
+    would have cost uncompressed — their ratio is the wire-format
+    compression factor, independent of the ring's 2(N-1)/N wire
+    amplification over the logical payload.  Both default to ``nbytes``
+    (store/mesh legs move logical bytes, uncompressed).  Always feeds the
+    aggregate counters; when armed it additionally annotates the
+    enclosing collective span (or records a standalone ``transport``
+    event)."""
     key = f"{op}/{path}"
     with _agg_mu:
         c = _agg.get(key)
         if c is None:
-            c = _agg[key] = {"calls": 0, "bytes": 0, "seconds": 0.0}
+            c = _agg[key] = {"calls": 0, "bytes": 0, "wire_bytes": 0,
+                             "raw_wire_bytes": 0, "seconds": 0.0}
         c["calls"] += 1
         c["bytes"] += int(nbytes)
+        c["wire_bytes"] += int(nbytes if wire_bytes is None else wire_bytes)
+        c["raw_wire_bytes"] += int(
+            nbytes if raw_wire_bytes is None
+            else raw_wire_bytes)
         c["seconds"] += float(seconds)
     rec = get_recorder()
     if rec is not None:
@@ -385,15 +398,22 @@ def record_transport(op: str, path: str, nbytes: int,
 
 def transport_counters(reset: bool = False) -> Dict[str, Dict[str, float]]:
     """Snapshot of the per-``op/transport`` counters, each entry
-    ``{calls, bytes, seconds, mb_per_s}``; ``reset=True`` atomically clears
-    after reading."""
+    ``{calls, bytes, wire_bytes, raw_wire_bytes, seconds, mb_per_s,
+    compression}`` — ``mb_per_s`` is *effective* (logical bytes over wall
+    time, the quantity benchmarks compare) and ``compression`` is
+    raw ÷ compressed wire bytes (1.0 uncompressed, at every world size);
+    ``reset=True`` atomically clears after reading."""
     with _agg_mu:
         out = {k: dict(v) for k, v in _agg.items()}
         if reset:
             _agg.clear()
     for v in out.values():
+        v.setdefault("wire_bytes", v["bytes"])  # pre-quant recordings
+        v.setdefault("raw_wire_bytes", v["wire_bytes"])
         v["mb_per_s"] = (v["bytes"] / v["seconds"] / 1e6
                          if v["seconds"] > 0 else 0.0)
+        v["compression"] = (v["raw_wire_bytes"] / v["wire_bytes"]
+                            if v["wire_bytes"] > 0 else 1.0)
     return out
 
 

@@ -32,8 +32,9 @@ __all__ = ["read_dumps", "merge_trace", "diagnose", "render_diagnosis"]
 _TID = {"collective": 0, "p2p": 1, "transport": 2, "store": 3, "beat": 4}
 _TID_NAMES = {0: "collectives", 1: "p2p", 2: "transport", 3: "store",
               4: "beats", 5: "other"}
-_ARG_KEYS = ("seq", "coll", "outcome", "site", "path", "bytes", "digest",
-             "reduce", "src", "dst", "peer", "key", "step", "detail")
+_ARG_KEYS = ("seq", "coll", "outcome", "site", "path", "bytes",
+             "wire_bytes", "raw_wire_bytes", "comm", "digest", "reduce",
+             "src", "dst", "peer", "key", "step", "detail")
 
 
 def read_dumps(path, generation: Optional[int] = None) -> List[dict]:

@@ -161,11 +161,30 @@ class ZeroOptimizer:
         reduce_op: "avg" (DDP convention, default) or "sum".
         dp: pin a specific DataPlane — in-process multi-rank test rigs
             only, like ``Bucketer(dp=...)`` (ring-only).
+        comm_dtype: wire compression for the gradient reduce-scatter — a
+            dtype name (cast) or an int8 block-quant scheme
+            (``"int8_block256"``, tpu_dist/collectives/quant.py); pinned
+            mode only, production reads ``TPU_DIST_COMM_DTYPE``.
+        error_feedback: keep a per-group **error-feedback residual** (the
+            owner's compression loss, shard-shaped) in the ZeRO state and
+            fold it back before compression each step — opt into this
+            whenever a lossy ``comm_dtype`` is configured, it is what
+            keeps training accuracy inside noise under aggressive wire
+            compression.  The residual lives in ``zstate["ef"]`` with the
+            exact flat per-dtype-group shard layout, so it rides sharded
+            checkpoints and the elastic reshard manifest like any other
+            shard-resident state.
+        gather_comm_dtype: optional wire compression for the parameter
+            all-gather (``ring_chunk_all_gather``) — **lossy on the
+            replicated parameters** (the master shards stay exact, like
+            a low-precision parameter broadcast in mixed-precision
+            training).  Default None: parameters move exact.
     """
 
     def __init__(self, opt, group=None, bucket_bytes: Optional[int] = None,
                  max_grad_norm: Optional[float] = None,
-                 reduce_op: str = "avg", dp=None, comm_dtype=None):
+                 reduce_op: str = "avg", dp=None, comm_dtype=None,
+                 error_feedback: bool = False, gather_comm_dtype=None):
         from ..collectives.bucketer import Bucketer
         self.opt = opt
         self.max_grad_norm = max_grad_norm
@@ -174,6 +193,8 @@ class ZeroOptimizer:
         self._bucketer = Bucketer(bucket_bytes=bucket_bytes, dp=dp,
                                   comm_dtype=comm_dtype)
         self._group = group
+        self.error_feedback = bool(error_feedback)
+        self.gather_comm_dtype = gather_comm_dtype
         self._plan: Optional[_Plan] = None
         # pinned-mode gather tag counter (same rationale as the Bucketer's)
         self._seq = 0
@@ -239,7 +260,16 @@ class ZeroOptimizer:
             "leaf_dtype": np.array([np.dtype(i.dtype).str
                                     for i in plan.leaves]),
         }
-        return {"shards": shards, "opt": self.opt.init(shards), "meta": meta}
+        state = {"shards": shards, "opt": self.opt.init(shards),
+                 "meta": meta}
+        if self.error_feedback:
+            # shard-shaped error-feedback residual, one flat array per
+            # dtype group in the EXACT shard layout — so it checkpoints,
+            # reshards (the manifest auto-detects group-length 1-D arrays
+            # as sharded), and slices into per-leaf views for the ring's
+            # owner-compression hook
+            state["ef"] = {k: np.zeros_like(v) for k, v in shards.items()}
+        return state
 
     def _check_state(self, state, plan: _Plan) -> None:
         meta = state.get("meta") if isinstance(state, dict) else None
@@ -269,15 +299,70 @@ class ZeroOptimizer:
 
     # -- step ----------------------------------------------------------------
 
-    def reduce_scatter(self, grads, group=None):
+    def _ef_views(self, state, plan: _Plan):
+        """An :class:`~tpu_dist.collectives.quant.ErrorFeedback` whose
+        per-leaf arrays are VIEWS into ``state['ef']``'s flat group
+        shards: the ring's owner-compression hook updates them in place,
+        which writes straight through to the checkpointable state — one
+        storage, two layouts.  Missing/mislaid ``ef`` (a pre-quant
+        checkpoint, or EF newly enabled) resets to zeros — losing a
+        residual costs one step of compression error, never correctness."""
+        from ..collectives.quant import ErrorFeedback
+        if not self.error_feedback:
+            return None
+        ef = ErrorFeedback()
+        ef_state = state.get("ef")
+        if not isinstance(ef_state, dict):
+            ef_state = state["ef"] = {}
+        for key, idxs in plan.groups:
+            want = sum(plan.leaves[i].span[1] - plan.leaves[i].span[0]
+                       for i in idxs)
+            flat = ef_state.get(key)
+            if flat is None or np.asarray(flat).size != want \
+                    or np.asarray(flat).dtype != np.dtype(key):
+                from ..utils import log_event
+                log_event("zero-ef-reset", group=key,
+                          have=(int(np.asarray(flat).size)
+                                if flat is not None else None),
+                          want=want)
+                flat = ef_state[key] = np.zeros(want, np.dtype(key))
+            else:
+                flat = ef_state[key] = np.ascontiguousarray(flat)
+            pos = 0
+            for i in idxs:
+                lo, hi = plan.leaves[i].span
+                ef.residuals[i] = flat[pos:pos + (hi - lo)]
+                pos += hi - lo
+        return ef
+
+    def reduce_scatter(self, grads, group=None, state=None):
         """Issue the bucketed async reduce-scatter of ``grads``; returns
         the :class:`~tpu_dist.collectives.bucketer.BucketWork` whose
         ``wait_all()`` yields this rank's owned flat gradient shards.
         Issue it right after the backward pass and let the loss readback /
         logging overlap the wire (the PR 5 discipline), then hand it to
-        :meth:`update`."""
+        :meth:`update`.
+
+        With ``error_feedback=True`` pass the current ZeRO ``state`` so
+        the shard-resident residual is folded in at the owner-compression
+        point (``update`` raises if you forget — the residual loop must
+        not silently drop out)."""
+        ef = None
+        if self.error_feedback:
+            if state is None:
+                raise ZeroStateError(
+                    "ZeroOptimizer(error_feedback=True).reduce_scatter "
+                    "needs the current state: call reduce_scatter(grads, "
+                    "state=zstate) so the shard-resident residual rides "
+                    "the compression hook")
+            if self._plan is None:
+                raise ZeroStateError(
+                    "ZeroOptimizer.reduce_scatter before init: call "
+                    "init(params) in this process first")
+            ef = self._ef_views(state, self._plan)
         return self._bucketer.reduce_scatter(grads, op=self.reduce_op,
-                                             group=group)
+                                             group=group,
+                                             error_feedback=ef)
 
     def update(self, grads, state, group=None,
                timeout: Optional[float] = None):
@@ -302,7 +387,8 @@ class ZeroOptimizer:
         if isinstance(grads, (BucketWork, ZeroParams)):
             frag_tree = grads.wait_all(timeout)
         else:
-            frag_tree = self.reduce_scatter(grads, group=group) \
+            frag_tree = self.reduce_scatter(grads, group=group,
+                                            state=state) \
                 .wait_all(timeout)
         frags = jax.tree.leaves(frag_tree)
         if len(frags) != len(plan.leaves):
@@ -328,8 +414,15 @@ class ZeroOptimizer:
                                               state["shards"])
         new_shards = {k: np.asarray(v) for k, v in new_shards.items()}
         handle = self._issue_gather(new_shards, plan, group)
-        return handle, {"shards": new_shards, "opt": new_opt,
-                        "meta": state["meta"]}
+        new_state = {"shards": new_shards, "opt": new_opt,
+                     "meta": state["meta"]}
+        if self.error_feedback:
+            # same arrays the reduce-scatter's views write through to —
+            # the residual carries across steps and checkpoints with the
+            # shards (zeros until the first compressed step touches it)
+            new_state["ef"] = state.get("ef") or {
+                k: np.zeros_like(v) for k, v in new_shards.items()}
+        return handle, new_state
 
     def _pinned_scalar_sum(self):
         """In pinned (in-process test-rig) mode the clip's scalar
@@ -459,10 +552,14 @@ class ZeroOptimizer:
                 dp = _eager._maybe_data_plane(group, store)
             with _eager._obs_span("zero_param_gather", value=buf):
                 t0 = _time.perf_counter()
-                out = _ring.ring_chunk_all_gather(dp, buf, bucket_bounds,
-                                                  tag=tag)
+                stats: dict = {}
+                out = _ring.ring_chunk_all_gather(
+                    dp, buf, bucket_bounds, tag=tag,
+                    comm_dtype=self.gather_comm_dtype, stats=stats)
                 _eager._record("zero_param_gather", "dataplane",
-                               buf.nbytes, t0)
+                               buf.nbytes, t0,
+                               wire_bytes=stats.get("wire_bytes"),
+                               raw_wire_bytes=stats.get("raw_wire_bytes"))
             return out
 
         return body
