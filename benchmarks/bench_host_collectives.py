@@ -27,6 +27,19 @@ second — the quantity the ISSUE 8 acceptance compares), and each row
 carries the measured wire-byte ``compression`` ratio from the transport
 counters.
 
+**Topology variants** (ISSUE 9): the dataplane all-reduce also runs as
+``algo``: ``flat`` (the flat TCP ring — SHM lanes off, the baseline every
+prior measurement used), ``flat_shm`` (same flat ring, shared-memory
+intra-host payload lanes — the TCP-vs-SHM isolate), and ``hier`` (the
+two-level host-major ring over SHM lanes —
+tpu_dist/collectives/topology.py).  Workers get simulated host
+fingerprints (``TPU_DIST_HOST_ID``): world >= 4 splits into 2 "hosts"
+host-contiguously (the 2-host x 2-rank acceptance layout), smaller worlds
+share one.  The final ``hier_vs_flat_speedup_8MiB_w{world}`` summary is
+the ISSUE 9 acceptance (>= 1.5x over the flat TCP ring); ``--smoke``
+additionally cross-checks hierarchical numerics BITWISE against the flat
+ring and compares result digests across ranks.
+
 Prints one BENCH-style JSON line per measurement::
 
     {"metric": "host_collective", "op": "all_reduce", "path": "dataplane",
@@ -99,32 +112,59 @@ def _worker() -> int:
 
     from tpu_dist.obs import recorder as _rec
 
+    def apply_case_env(case):
+        os.environ["TPU_DIST_DP_THRESHOLD"] = (
+            "0" if case["path"] == "dataplane" else str(1 << 60))
+        if case.get("comm"):
+            os.environ["TPU_DIST_COMM_DTYPE"] = case["comm"]
+        else:
+            os.environ.pop("TPU_DIST_COMM_DTYPE", None)
+        # topology variants: algo picks the ring shape, shm the intra-host
+        # payload transport.  Plain rows pin algo=flat + SHM off so the
+        # baseline stays the flat TCP ring every prior round measured.
+        algo = case.get("algo", "flat")
+        os.environ["TPU_DIST_ALGO"] = "hier" if algo == "hier" else "flat"
+        os.environ["TPU_DIST_SHM"] = (
+            "auto" if algo in ("hier", "flat_shm") else "0")
+
     rows = []
     for ci, case in enumerate(spec["cases"]):
         nbytes, op, path, iters = (case["bytes"], case["op"], case["path"],
                                    case["iters"])
         comm = case.get("comm")
+        algo = case.get("algo", "flat")
         x = (np.random.default_rng(1000 + rank)
              .standard_normal(nbytes // 4).astype(np.float32))
-        os.environ["TPU_DIST_DP_THRESHOLD"] = (
-            "0" if path == "dataplane" else str(1 << 60))
-        if comm:
-            os.environ["TPU_DIST_COMM_DTYPE"] = comm
-        else:
-            os.environ.pop("TPU_DIST_COMM_DTYPE", None)
+        apply_case_env(case)
         out = run_op(op, x)  # warm-up: opens peer connections, primes numpy
         if spec.get("check") and op == "all_reduce":
-            os.environ.pop("TPU_DIST_COMM_DTYPE", None)
-            os.environ["TPU_DIST_DP_THRESHOLD"] = str(1 << 60)
-            ref = run_op(op, x)
-            if comm:
-                # lossy wire: bounded relative error, and — the property
-                # compression must never cost — byte-identical results on
-                # every rank (digests compared through the store)
-                err = float(np.max(np.abs(np.asarray(out) - ref)))
-                bound = float(np.max(np.abs(ref))) * (
-                    0.1 if comm.startswith("int8") else 0.02)
-                assert err <= bound, (comm, err, bound)
+            # every rank takes the same branch (case fields are shared),
+            # so the reference collectives stay rank-aligned
+            if algo in ("hier", "flat_shm"):
+                # the ISSUE 9 acceptance property: hierarchical (and the
+                # SHM transport) results are BITWISE-equal to the flat
+                # TCP ring on the host-contiguous layout
+                apply_case_env({"path": "dataplane"})
+                flat = run_op(op, x)
+                assert np.array_equal(np.asarray(out), np.asarray(flat)), \
+                    f"{algo} result != flat ring bitwise"
+            else:
+                os.environ.pop("TPU_DIST_COMM_DTYPE", None)
+                os.environ["TPU_DIST_DP_THRESHOLD"] = str(1 << 60)
+                ref = run_op(op, x)  # store-path reference
+                if comm:
+                    # lossy wire: bounded relative error, and — the
+                    # property compression must never cost —
+                    # byte-identical results on every rank (digests
+                    # compared through the store)
+                    err = float(np.max(np.abs(np.asarray(out) - ref)))
+                    bound = float(np.max(np.abs(ref))) * (
+                        0.1 if comm.startswith("int8") else 0.02)
+                    assert err <= bound, (comm, err, bound)
+                else:
+                    np.testing.assert_allclose(out, ref, rtol=2e-6,
+                                               atol=1e-5)
+            if comm or algo in ("hier", "flat_shm"):
                 import hashlib
                 dig = hashlib.sha256(np.ascontiguousarray(out).tobytes()) \
                     .hexdigest().encode()
@@ -132,30 +172,34 @@ def _worker() -> int:
                 store.barrier(world, tag=f"qdig{ci}")
                 digs = {store.get(f"bench/qdig/{ci}/{r}")
                         for r in range(world)}
-                assert len(digs) == 1, f"rank-divergent quantized result"
-            else:
-                np.testing.assert_allclose(out, ref, rtol=2e-6, atol=1e-5)
-            if comm:
-                os.environ["TPU_DIST_COMM_DTYPE"] = comm
-            os.environ["TPU_DIST_DP_THRESHOLD"] = (
-                "0" if path == "dataplane" else str(1 << 60))
-        tag = f"{op}/{path}/{comm}/{nbytes}"
-        store.barrier(world, tag=tag)
-        _rec.reset_transport_counters()
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            run_op(op, x)
-        dt = time.perf_counter() - t0
-        counters = _rec.transport_counters(reset=True).get(f"{op}/{path}")
+                assert len(digs) == 1, "rank-divergent collective result"
+            apply_case_env(case)
+        # best-of-reps against 2-core scheduler noise (the
+        # bench_obs_overhead discipline: max-MB/s aggregation — identical
+        # configs otherwise swing +-50% run to run on this box)
+        reps = max(1, int(case.get("reps", 1)))
+        tag = f"{op}/{path}/{comm}/{algo}/{nbytes}"
+        best, counters = None, None
+        for rep in range(reps):
+            store.barrier(world, tag=f"{tag}/r{rep}")
+            _rec.reset_transport_counters()
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                run_op(op, x)
+            dt = time.perf_counter() - t0
+            c = _rec.transport_counters(reset=True).get(f"{op}/{path}")
+            v = nbytes * iters / dt / 1e6
+            if best is None or v > best:
+                best, counters = v, c
         row = {"metric": "host_collective", "op": op, "path": path,
                "world": world, "bytes": nbytes, "iters": iters,
-               "comm": comm or "f32",
-               "value": round(nbytes * iters / dt / 1e6, 2),
-               "unit": "MB/s"}
+               "reps": reps, "comm": comm or "f32", "algo": algo,
+               "value": round(best, 2), "unit": "MB/s"}
         if counters:
             row["compression"] = round(counters["compression"], 2)
         rows.append(row)
-    os.environ.pop("TPU_DIST_COMM_DTYPE", None)
+    for key in ("TPU_DIST_COMM_DTYPE", "TPU_DIST_ALGO", "TPU_DIST_SHM"):
+        os.environ.pop(key, None)
     if rank == 0:
         with open(os.environ["BENCH_OUT"], "w") as f:
             json.dump(rows, f)
@@ -176,12 +220,21 @@ def _iters_for(nbytes: int, path: str) -> int:
     return 6 if nbytes >= (1 << 20) else 12
 
 
+def _reps_for(path: str, smoke: bool) -> int:
+    # dataplane rows take best-of-3 (cheap, and the acceptance ratios live
+    # there); the store path is too slow to repeat and not ratio-gated
+    if smoke or path == "store":
+        return 1
+    return 3
+
+
 def _run_world(world: int, sizes, iters_override, check: bool,
                out_path: str):
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     from tpu_dist.dist.store import TCPStore
 
     cases = [{"op": op, "path": path, "bytes": nbytes, "comm": None,
+              "reps": _reps_for(path, check),
               "iters": iters_override or _iters_for(nbytes, path)}
              for op in _OPS
              for nbytes in sizes
@@ -189,10 +242,23 @@ def _run_world(world: int, sizes, iters_override, check: bool,
     # wire-compression variants of the dataplane ring all-reduce: bf16
     # cast vs int8 block quantization vs the plain-f32 row above
     cases += [{"op": "all_reduce", "path": "dataplane", "bytes": nbytes,
-               "comm": comm,
+               "comm": comm, "reps": _reps_for("dataplane", check),
                "iters": iters_override or _iters_for(nbytes, "dataplane")}
               for nbytes in sizes
               for comm in ("bfloat16", "int8_block256")]
+    # topology variants: flat ring over SHM lanes (TCP-vs-SHM isolate) and
+    # the hierarchical two-level ring (the ISSUE 9 acceptance rows)
+    cases += [{"op": "all_reduce", "path": "dataplane", "bytes": nbytes,
+               "comm": None, "algo": algo,
+               "reps": _reps_for("dataplane", check),
+               "iters": iters_override or _iters_for(nbytes, "dataplane")}
+              for nbytes in sizes
+              for algo in ("flat_shm", "hier")]
+    # simulated host layout (host-contiguous): world >= 4 splits into two
+    # "hosts" (the 2-host x 2-rank acceptance layout at world 4); smaller
+    # worlds co-locate on one, so SHM lanes exist at every world
+    nhosts = 2 if world >= 4 else 1
+
     store = TCPStore(is_master=True)
     procs = []
     try:
@@ -207,9 +273,12 @@ def _run_world(world: int, sizes, iters_override, check: bool,
         env.pop("TPU_DIST_RESTART_COUNT", None)
         procs = [subprocess.Popen(
             [sys.executable, "-m", "benchmarks.bench_host_collectives",
-             "--worker"], env=dict(env, RANK=str(r)), cwd=_REPO)
+             "--worker"],
+            env=dict(env, RANK=str(r),
+                     TPU_DIST_HOST_ID=f"h{r * nhosts // world}"),
+            cwd=_REPO)
             for r in range(world)]
-        deadline = time.monotonic() + 600
+        deadline = time.monotonic() + (600 if check else 1800)
         rcs = [p.wait(timeout=max(1, deadline - time.monotonic()))
                for p in procs]
         if any(rcs):
@@ -261,11 +330,14 @@ def main(argv=None) -> int:
             print(json.dumps(row))
         all_rows.extend(rows)
 
-    # the ISSUE 2 / ISSUE 8 acceptance quantities, when measured
-    by_key = {(r["op"], r["path"], r.get("comm", "f32"), r["world"],
-               r["bytes"]): r["value"] for r in all_rows}
-    ring = by_key.get(("all_reduce", "dataplane", "f32", 4, 8 << 20))
-    store_v = by_key.get(("all_reduce", "store", "f32", 4, 8 << 20))
+    # the ISSUE 2 / ISSUE 8 / ISSUE 9 acceptance quantities, when measured
+    by_key = {(r["op"], r["path"], r.get("comm", "f32"),
+               r.get("algo", "flat"), r["world"], r["bytes"]): r["value"]
+              for r in all_rows}
+    ring = by_key.get(("all_reduce", "dataplane", "f32", "flat", 4,
+                       8 << 20))
+    store_v = by_key.get(("all_reduce", "store", "f32", "flat", 4,
+                          8 << 20))
     if ring and store_v:
         print(json.dumps({"metric": "ring_vs_store_speedup_8MiB_w4",
                           "value": round(ring / store_v, 2),
@@ -277,15 +349,25 @@ def main(argv=None) -> int:
     # so the per-world rows tell the honest story — see
     # docs/collectives.md §quantized
     for world in worlds:
-        ring_w = by_key.get(("all_reduce", "dataplane", "f32", world,
-                             8 << 20))
+        ring_w = by_key.get(("all_reduce", "dataplane", "f32", "flat",
+                             world, 8 << 20))
         quant_w = by_key.get(("all_reduce", "dataplane", "int8_block256",
-                              world, 8 << 20))
+                              "flat", world, 8 << 20))
         if ring_w and quant_w:
             print(json.dumps(
                 {"metric": f"quant_vs_f32_speedup_8MiB_w{world}",
                  "value": round(quant_w / ring_w, 2),
                  "unit": "x", "threshold": 2.0}))
+        # ISSUE 9 acceptance: the two-level SHM ring vs the flat TCP ring
+        # (>= 1.5x at 8 MiB on the simulated 2-host x 2-rank world-4
+        # layout); results bitwise-equal, checked in --smoke
+        hier_w = by_key.get(("all_reduce", "dataplane", "f32", "hier",
+                             world, 8 << 20))
+        if ring_w and hier_w:
+            print(json.dumps(
+                {"metric": f"hier_vs_flat_speedup_8MiB_w{world}",
+                 "value": round(hier_w / ring_w, 2),
+                 "unit": "x", "threshold": 1.5}))
     return 0
 
 

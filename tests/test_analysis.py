@@ -192,6 +192,42 @@ def collect(stats_zero, nonzero_counts):
     nonzero_counts.update(x=2)
 """
 
+# rank-divergent member list: every rank builds a DIFFERENT group, whose
+# ids/store scopes/wire tags can never match across ranks
+TD008_POS = """
+def setup(rank, world):
+    g = new_group([rank, (rank + 1) % world])
+    return g
+"""
+
+TD008_NEG = """
+def setup(rank):
+    g = new_group([0, 1])
+    if g.rank is not None:
+        y = C.all_reduce_host(1.0, group=g)
+    return g
+"""
+
+# collective on a literal sub-group with NO membership guard: non-member
+# ranks reach the call too (GroupMembershipError at runtime, or a member
+# desync when only some ranks guard)
+TD008_UNGUARDED_POS = """
+def run(x, rank):
+    g = new_group([0, 1])
+    return C.all_reduce_host(x, group=g)
+"""
+
+# the guarded form must stay clean for BOTH rules: the membership guard is
+# a rank conditional, but a sub-group-scoped collective under it is the
+# CORRECT pattern (only members call), so TD001/TD002 cede it to TD008
+TD008_GUARDED_RANK_NEG = """
+def run(x, rank):
+    g = new_group([0, 1])
+    if rank in (0, 1):
+        return C.all_reduce_host(x, group=g)
+    return None
+"""
+
 
 class TestRules:
     @pytest.mark.parametrize("rule,pos,neg", [
@@ -202,6 +238,7 @@ class TestRules:
         ("TD005", TD005_POS, TD005_NEG),
         ("TD006", TD006_POS, TD006_NEG),
         ("TD007", TD007_POS, TD007_NEG),
+        ("TD008", TD008_POS, TD008_NEG),
     ])
     def test_positive_flags_negative_passes(self, rule, pos, neg):
         assert rule in _rules(lint_source(pos, f"{rule}_pos.py")), \
@@ -253,7 +290,17 @@ class TestRules:
 
     def test_rule_docs_cover_all_codes(self):
         assert sorted(RULE_DOCS) == ["TD001", "TD002", "TD003", "TD004",
-                                     "TD005", "TD006", "TD007"]
+                                     "TD005", "TD006", "TD007", "TD008"]
+
+    def test_td008_unguarded_group_collective_warns(self):
+        found = lint_source(TD008_UNGUARDED_POS, "t.py")
+        assert _rules(found) == ["TD008"]
+        (f,) = found
+        assert f.severity == "warning" and "membership" in f.message
+
+    def test_td008_guarded_group_collective_clean_for_all_rules(self):
+        # the correct pattern must not trade a TD008 for a TD001
+        assert _rules(lint_source(TD008_GUARDED_RANK_NEG, "t.py")) == []
 
     def test_td007_assigned_then_unused_handle(self):
         found = lint_source(TD007_ASSIGNED_UNUSED, "t.py")
@@ -557,6 +604,23 @@ _SAN_MISSING_WORKER = _SAN_PRELUDE + textwrap.dedent("""
                 "elapsed": round(time.monotonic() - t0, 2)})
 """)
 
+# the two ranks build sub-groups over the same member SET but divergent
+# ring ORDER (a rank-divergent member list — the TD008 bug reaching
+# runtime): the group-scoped signatures land in the same (set-derived)
+# namespace, so the sanitizer must fail BOTH ranks naming BOTH memberships
+# before any payload moves
+_SAN_GROUP_MISMATCH_WORKER = _SAN_PRELUDE + textwrap.dedent("""
+    members = [0, 1] if rank == 0 else [1, 0]  # the bug under test
+    sub = C.new_group(members, group=g)
+    x = np.ones(256, np.float32)
+    try:
+        C.all_reduce_host(x, group=sub, op="sum")
+        finish({"error": None})
+    except CollectiveMismatchError as e:
+        finish({"error": "CollectiveMismatchError", "message": str(e),
+                "seq": e.seq})
+""")
+
 # matched collectives must pass the check and produce correct numbers
 _SAN_CLEAN_WORKER = _SAN_PRELUDE + textwrap.dedent("""
     x = np.full(256, float(rank + 1), np.float32)
@@ -629,6 +693,16 @@ class TestSanitizerE2E:
             assert "comm" in out["message"], out["message"]
             assert "int8_block256" in out["message"], out["message"]
             assert "bfloat16" in out["message"], out["message"]
+
+    def test_mismatched_group_membership_fails_naming_both(self, tmp_path):
+        res = _spawn_sanitized(tmp_path, _SAN_GROUP_MISMATCH_WORKER)
+        for r, out in enumerate(res):
+            assert out["error"] == "CollectiveMismatchError", (r, out)
+            # the divergence detail carries BOTH ordered memberships, so
+            # the rank-divergent new_group list is readable off the error
+            assert "group" in out["message"], out["message"]
+            assert "[0, 1]" in out["message"], out["message"]
+            assert "[1, 0]" in out["message"], out["message"]
 
     def test_missing_rank_fails_within_deadline_not_hang(self, tmp_path):
         res = _spawn_sanitized(tmp_path, _SAN_MISSING_WORKER)

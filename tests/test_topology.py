@@ -1,0 +1,633 @@
+"""Topology-aware collectives (ISSUE 9): SHM intra-host lanes, scoped
+sub-groups, the hierarchical (two-level) ring, and algorithm autoselection.
+
+Tier-1 on purpose (``topology`` marker, NOT ``slow``): SHM lanes are now
+the default intra-host transport of the data plane, so they must be proven
+on every PR.  In-process rigs (one DataPlane per 'rank', threads) cover
+frame parity and ring numerics; spawned worlds cover the eager sub-group
+path and the SHM peer-death chaos e2e.  Simulated host layouts come from
+``TPU_DIST_HOST_ID_R{rank}`` (per-rank fingerprint override).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import numpy as np
+import pytest
+
+pytestmark = [pytest.mark.topology]
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def store():
+    from tpu_dist.dist.store import TCPStore
+    s = TCPStore(is_master=True)
+    yield s
+    s.close()
+
+
+@pytest.fixture
+def hosts(monkeypatch):
+    """Per-rank host fingerprints for in-process rigs."""
+    def set_hosts(mapping):
+        for r, h in mapping.items():
+            monkeypatch.setenv(f"TPU_DIST_HOST_ID_R{r}", h)
+    return set_hosts
+
+
+def _run_world(store, n, fn, timeout=60):
+    from tpu_dist.collectives.transport import DataPlane
+    dps = [DataPlane(store, r, n) for r in range(n)]
+    out, errs = [None] * n, []
+
+    def run(r):
+        try:
+            out[r] = fn(dps[r], r)
+        except Exception as e:
+            errs.append((r, e))
+
+    threads = [threading.Thread(target=run, args=(r,)) for r in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout)
+    for dp in dps:
+        dp.close()
+    assert not errs, errs
+    return out, dps
+
+
+# ---------------------------------------------------------------------------
+# ShmLane unit
+# ---------------------------------------------------------------------------
+
+
+class TestShmLane:
+    def test_roundtrip_and_wraparound(self):
+        from tpu_dist.collectives.shm import ShmLane
+        tx = ShmLane(create=True, capacity=4096)
+        try:
+            rx = ShmLane(name=tx.name)
+            rng = np.random.default_rng(0)
+            for size in (1, 100, 4096, 5000, 3):   # 5000 > capacity: wraps
+                payload = rng.integers(0, 256, size, dtype=np.uint8)
+                buf = bytearray(size)
+                t = threading.Thread(
+                    target=tx.write, args=(payload.tobytes(), 30))
+                t.start()
+                rx.read_into(buf, timeout=30)
+                t.join(10)
+                assert bytes(buf) == payload.tobytes(), size
+            rx.close()
+        finally:
+            tx.close()
+
+    def test_partial_write_resume_frame_bigger_than_ring(self):
+        from tpu_dist.collectives.shm import ShmLane
+        tx = ShmLane(create=True, capacity=4096)
+        try:
+            rx = ShmLane(name=tx.name)
+            payload = np.random.default_rng(1).integers(
+                0, 256, 1 << 16, dtype=np.uint8).tobytes()  # 16x the ring
+            buf = bytearray(len(payload))
+            t = threading.Thread(target=tx.write, args=(payload, 30))
+            t.start()
+            rx.read_into(buf, timeout=30)
+            t.join(10)
+            assert not t.is_alive()
+            assert bytes(buf) == payload
+            rx.close()
+        finally:
+            tx.close()
+
+    def test_read_abort_check_raises_connection_error(self):
+        from tpu_dist.collectives.shm import ShmLane
+        tx = ShmLane(create=True, capacity=4096)
+        try:
+            rx = ShmLane(name=tx.name)
+            buf = bytearray(64)   # nothing will ever be written
+            with pytest.raises(ConnectionError, match="peer died"):
+                rx.read_into(buf, timeout=30,
+                             abort_check=lambda: "peer died (test)")
+            rx.close()
+        finally:
+            tx.close()
+
+    def test_read_deadline_raises_timeout(self):
+        from tpu_dist.collectives.shm import ShmLane
+        tx = ShmLane(create=True, capacity=4096)
+        try:
+            rx = ShmLane(name=tx.name)
+            with pytest.raises(TimeoutError):
+                rx.read_into(bytearray(8), timeout=0.2)
+            rx.close()
+        finally:
+            tx.close()
+
+
+# ---------------------------------------------------------------------------
+# SHM transport: frame parity with TCP
+# ---------------------------------------------------------------------------
+
+
+class TestShmTransport:
+    def _pair(self, store, same_host):
+        from tpu_dist.collectives.transport import DataPlane
+        dp0 = DataPlane(store, 0, 2)
+        dp1 = DataPlane(store, 1, 2)
+        return dp0, dp1
+
+    def test_frames_ride_shm_when_colocated(self, store, hosts):
+        hosts({0: "hX", 1: "hX"})
+        dp0, dp1 = self._pair(store, True)
+        try:
+            a = np.arange(9001, dtype=np.float32)
+            dp0.send_array(1, "t", a)
+            got = dp1.recv_array(0, "t", timeout=30)
+            np.testing.assert_array_equal(got, a)
+            assert dp0.shm_active(1), "co-located pair should use the lane"
+        finally:
+            dp0.close()
+            dp1.close()
+
+    def test_tcp_when_hosts_differ_or_disabled(self, store, hosts,
+                                               monkeypatch):
+        hosts({0: "hX", 1: "hY"})
+        dp0, dp1 = self._pair(store, False)
+        try:
+            dp0.send_array(1, "t", np.ones(4096, np.float32))
+            dp1.recv_array(0, "t", timeout=30)
+            assert not dp0.shm_active(1)
+        finally:
+            dp0.close()
+            dp1.close()
+        monkeypatch.setenv("TPU_DIST_SHM", "0")
+        hosts({2: "hZ", 3: "hZ"})
+        from tpu_dist.collectives.transport import DataPlane
+        dp2, dp3 = DataPlane(store, 2, 4), DataPlane(store, 3, 4)
+        try:
+            dp2.send_array(3, "t", np.ones(4096, np.float32))
+            dp3.recv_array(2, "t", timeout=30)
+            assert not dp2.shm_active(3), "TPU_DIST_SHM=0 must force TCP"
+        finally:
+            dp2.close()
+            dp3.close()
+
+    def test_shm_frame_parity_with_tcp(self, store, hosts, monkeypatch):
+        """Every frame shape the TCP path carries — dtypes, 0-d, empty,
+        bf16, quant — arrives identically through the lane."""
+        import ml_dtypes
+        from tpu_dist.collectives import quant as Q
+        frames = [np.arange(12, dtype=np.int32).reshape(3, 4),
+                  np.linspace(0, 1, 10007, dtype=np.float32),
+                  np.ones((2, 3, 2), dtype=ml_dtypes.bfloat16),
+                  np.array([], dtype=np.float64),
+                  np.array(3.5, dtype=np.float32)]
+        sch = Q.QuantScheme(256)
+        qpay = np.random.default_rng(3).standard_normal(5003) \
+            .astype(np.float32)
+        q, s = Q.quantize(qpay, sch)
+
+        def roundtrip(shm_on):
+            monkeypatch.setenv("TPU_DIST_SHM", "auto" if shm_on else "0")
+            hosts({0: "hS", 1: "hS"})
+            dp0, dp1 = self._pair(store, True)
+            try:
+                out = []
+                for i, arr in enumerate(frames):
+                    dp0.send_array(1, f"f{i}", arr)
+                    out.append(dp1.recv_array(0, f"f{i}", timeout=30))
+                dp0.send_quant(1, "q", Q.QuantChunk(q, s, sch))
+                chunk = dp1.recv_array(0, "q", timeout=30)
+                assert dp0.shm_active(1) == shm_on
+                return out, chunk
+            finally:
+                dp0.close()
+                dp1.close()
+
+        shm_out, shm_chunk = roundtrip(True)
+        tcp_out, tcp_chunk = roundtrip(False)
+        for a, b, src in zip(shm_out, tcp_out, frames):
+            assert a.dtype == b.dtype == src.dtype
+            assert a.shape == b.shape == src.shape
+            np.testing.assert_array_equal(np.asarray(a, np.float64),
+                                          np.asarray(b, np.float64))
+        np.testing.assert_array_equal(shm_chunk.q, tcp_chunk.q)
+        np.testing.assert_array_equal(shm_chunk.scales, tcp_chunk.scales)
+        assert shm_chunk.scheme is tcp_chunk.scheme
+
+    def test_partial_write_resume_through_dataplane(self, store, hosts,
+                                                    monkeypatch):
+        """A frame bigger than the whole ring flows via partial-write
+        resume while the receiver drains concurrently."""
+        monkeypatch.setenv("TPU_DIST_SHM_RING", "65536")
+        hosts({0: "hP", 1: "hP"})
+        dp0, dp1 = self._pair(store, True)
+        try:
+            huge = np.random.default_rng(5).standard_normal(1 << 18) \
+                .astype(np.float32)   # 1 MiB >> 64 KiB ring
+            t = threading.Thread(
+                target=dp0.send_array, args=(1, "h", huge))
+            t.start()
+            got = dp1.recv_array(0, "h", timeout=60)
+            t.join(30)
+            assert not t.is_alive()
+            np.testing.assert_array_equal(got, huge)
+            assert dp0.shm_active(1)
+        finally:
+            dp0.close()
+            dp1.close()
+
+
+# ---------------------------------------------------------------------------
+# sub-groups
+# ---------------------------------------------------------------------------
+
+
+class TestSubGroup:
+    def test_membership_and_ids(self):
+        from tpu_dist.collectives import topology as T
+        a = T.SubGroup((1, 3), parent_rank=1, parent_world=4, instance=0)
+        b = T.SubGroup((1, 3), parent_rank=0, parent_world=4, instance=0)
+        assert a.rank == 0 and a.num_processes == 2
+        assert b.rank is None
+        assert a.group_id == b.group_id  # same list, same instance
+        with pytest.raises(T.GroupMembershipError, match="not a member"):
+            b.require_member()
+        # order-divergent lists share the set scope but not the id
+        c = T.SubGroup((3, 1), parent_rank=1, parent_world=4, instance=0)
+        assert c.set_scope == a.set_scope and c.group_id != a.group_id
+
+    def test_new_group_validation(self):
+        from tpu_dist.collectives import topology as T
+
+        class _G:
+            rank, num_processes = 0, 4
+        with pytest.raises(ValueError, match="duplicate"):
+            T.new_group([0, 0], group=_G())
+        with pytest.raises(ValueError, match="out of range"):
+            T.new_group([0, 7], group=_G())
+        g1 = T.new_group([0, 1], group=_G())
+        g2 = T.new_group([0, 1], group=_G())
+        assert g1.group_id != g2.group_id  # fresh instance per creation
+
+    def test_subgroup_ring_numerics_and_isolation(self, store, hosts):
+        """Two disjoint sub-groups run ring collectives CONCURRENTLY over
+        one world-4 data plane: results are right and never cross."""
+        from tpu_dist.collectives import ring
+        from tpu_dist.collectives import topology as T
+        hosts({r: "h0" for r in range(4)})
+        g_even = [T.SubGroup((0, 2), r, 4, instance=0) for r in range(4)]
+        g_odd = [T.SubGroup((1, 3), r, 4, instance=0) for r in range(4)]
+
+        def fn(dp, r):
+            grp = (g_even if r % 2 == 0 else g_odd)[r]
+            gdp = grp.view(dp)
+            x = np.full(7001, float(r + 1), np.float32)
+            out = ring.ring_all_reduce(gdp, x, op="sum", tag="iso")
+            ag = ring.ring_all_gather(gdp, np.full(11, float(r), np.float32),
+                                      tag="isoag")
+            return out, ag
+
+        out, _ = _run_world(store, 4, fn)
+        np.testing.assert_allclose(out[0][0], np.full(7001, 1.0 + 3.0))
+        np.testing.assert_allclose(out[1][0], np.full(7001, 2.0 + 4.0))
+        np.testing.assert_array_equal(out[0][0], out[2][0])
+        np.testing.assert_array_equal(out[1][0], out[3][0])
+        # all-gather blocks land in GROUP-local rank order
+        np.testing.assert_array_equal(out[2][1][0], np.full(11, 0.0))
+        np.testing.assert_array_equal(out[2][1][1], np.full(11, 2.0))
+
+    def test_subgroup_ring_with_quant_and_bounds(self, store, hosts):
+        """comm_dtype quantization and a custom bounds= partition run
+        unchanged inside a group (the tentpole's compatibility claim)."""
+        from tpu_dist.collectives import ring
+        from tpu_dist.collectives import topology as T
+        hosts({r: "h0" for r in range(3)})
+        groups = [T.SubGroup((0, 2), r, 3, instance=0) for r in range(3)]
+        n_el = 10007
+        bounds = [(0, 128), (128, n_el)]
+
+        def fn(dp, r):
+            if r == 1:
+                return None
+            gdp = groups[r].view(dp)
+            x = np.random.default_rng(10 + r).standard_normal(n_el) \
+                .astype(np.float32)
+            qr = ring.ring_all_reduce(gdp, x, op="sum",
+                                      comm_dtype="int8_block256", tag="q")
+            br = ring.ring_all_reduce(gdp, x, op="sum", bounds=bounds,
+                                      tag="b")
+            rs = ring.ring_reduce_scatter(gdp, x, op="sum", tag="rs")
+            return qr, br, rs
+
+        out, _ = _run_world(store, 3, fn)
+        ref = sum(np.random.default_rng(10 + r).standard_normal(n_el)
+                  .astype(np.float32) for r in (0, 2))
+        np.testing.assert_array_equal(out[0][0], out[2][0])  # quant: rank-id
+        np.testing.assert_allclose(out[0][0], ref, rtol=0.05, atol=0.6)
+        np.testing.assert_allclose(out[0][1], ref, rtol=2e-6, atol=1e-4)
+        # reduce-scatter shards: group-local rank 0 owns the first span
+        lo, hi = ring.ring_chunk_span(n_el, 2, 0)
+        np.testing.assert_array_equal(out[0][2], out[0][1][lo:hi])
+
+
+# ---------------------------------------------------------------------------
+# hierarchical vs flat: bitwise parity
+# ---------------------------------------------------------------------------
+
+
+class TestHierarchical:
+    @pytest.mark.parametrize("world,layout", [
+        (2, {0: "a", 1: "a"}),
+        (3, {0: "a", 1: "a", 2: "b"}),
+        (4, {0: "a", 1: "a", 2: "b", 3: "b"}),
+    ])
+    @pytest.mark.parametrize("op", ["sum", "avg"])
+    def test_hier_bitwise_equals_flat(self, store, hosts, world, layout,
+                                      op):
+        """Host-contiguous layouts: the two-level ring's fold order IS the
+        flat ring's, so results are bitwise-identical — sum/avg, uneven
+        payloads, every world."""
+        from tpu_dist.collectives import ring
+        from tpu_dist.collectives import topology as T
+        hosts(layout)
+        n_el = 10007  # coprime with 2-4: chunking is never even
+
+        def fn(dp, r):
+            x = np.random.default_rng(20 + r).standard_normal(n_el) \
+                .astype(np.float32)
+            h = T.hier_all_reduce(dp, x, op=op, tag="h")
+            f = ring.ring_all_reduce(dp, x, op=op, tag="f")
+            topo = T.detect_topology(dp)
+            return h, f, topo.host_major_order()
+
+        out, _ = _run_world(store, world, fn)
+        for r in range(world):
+            assert out[r][2] == list(range(world))
+            np.testing.assert_array_equal(
+                out[r][0], out[r][1],
+                err_msg=f"hier != flat bitwise at rank {r}")
+        for r in range(1, world):
+            np.testing.assert_array_equal(out[0][0], out[r][0])
+
+    def test_hier_bitwise_under_quant_wire(self, store, hosts):
+        from tpu_dist.collectives import ring
+        from tpu_dist.collectives import topology as T
+        hosts({0: "a", 1: "a", 2: "b", 3: "b"})
+
+        def fn(dp, r):
+            x = np.random.default_rng(30 + r).standard_normal(8009) \
+                .astype(np.float32)
+            h = T.hier_all_reduce(dp, x, op="sum",
+                                  comm_dtype="int8_block256", tag="hq")
+            f = ring.ring_all_reduce(dp, x, op="sum",
+                                     comm_dtype="int8_block256", tag="fq")
+            return h, f
+
+        out, _ = _run_world(store, 4, fn)
+        for r in range(4):
+            np.testing.assert_array_equal(out[r][0], out[r][1])
+
+    def test_hier_interleaved_layout_reorders_and_agrees(self, store,
+                                                         hosts):
+        """Interleaved hosts: the two-level ring re-orders host-major;
+        results are deterministic, identical on every rank, and equal to
+        the flat ring up to float re-association (documented contract)."""
+        from tpu_dist.collectives import topology as T
+        hosts({0: "a", 1: "b", 2: "a", 3: "b"})
+
+        def fn(dp, r):
+            topo = T.detect_topology(dp)
+            x = np.random.default_rng(40 + r).standard_normal(6007) \
+                .astype(np.float32)
+            return T.hier_all_reduce(dp, x, op="sum", tag="hi"), \
+                topo.host_major_order()
+
+        out, _ = _run_world(store, 4, fn)
+        assert out[0][1] == [0, 2, 1, 3]
+        ref = sum(np.random.default_rng(40 + r).standard_normal(6007)
+                  .astype(np.float32) for r in range(4))
+        for r in range(4):
+            np.testing.assert_array_equal(out[0][0], out[r][0])
+        np.testing.assert_allclose(out[0][0], ref, rtol=2e-5, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# algorithm autoselection
+# ---------------------------------------------------------------------------
+
+
+class TestAutoselect:
+    def test_env_overrides_and_auto_policy(self, monkeypatch):
+        from tpu_dist.collectives import topology as T
+        topo = T.Topology(["a", "a", "b", "b"])
+        monkeypatch.setenv("TPU_DIST_ALGO_CORES", "8")
+        assert T.select_algo(8 << 20, topo=topo) == ("hier", True)
+        assert T.select_algo(1024, topo=topo) == ("flat", True)
+        # no co-location: nothing hierarchical to do
+        flat_topo = T.Topology(["a", "b", "c", "d"])
+        assert T.select_algo(8 << 20, topo=flat_topo) == ("flat", True)
+        # explicit modes win and keep compression
+        monkeypatch.setenv("TPU_DIST_ALGO", "flat")
+        assert T.select_algo(8 << 20, topo=topo) == ("flat", True)
+        monkeypatch.setenv("TPU_DIST_ALGO", "hier")
+        assert T.select_algo(1024, topo=topo) == ("hier", True)
+        monkeypatch.setenv("TPU_DIST_ALGO", "bogus")
+        with pytest.raises(ValueError, match="TPU_DIST_ALGO"):
+            T.select_algo(1024, topo=topo)
+
+    def test_compute_bound_guard_closes_quant_inversion(self, monkeypatch):
+        """ranks-per-host > cores (the PR 8 world-4 inversion regime):
+        auto falls back to the flat f32 ring — compression suppressed."""
+        from tpu_dist.collectives import topology as T
+        topo = T.Topology(["a", "a", "a", "a"])   # 4 ranks, one host
+        monkeypatch.setenv("TPU_DIST_ALGO_CORES", "2")
+        assert T.select_algo(8 << 20, topo=topo) == ("flat", False)
+        # at ranks-per-host == cores (PR 8's world-2 regime, where int8
+        # measured 2.57x FASTER) compression stays on
+        topo2 = T.Topology(["a", "a", "b", "b"])
+        assert T.select_algo(8 << 20, topo=topo2) == ("hier", True)
+
+    def test_store_agreed_cores_on_heterogeneous_hosts(self, monkeypatch):
+        """The guard's core budget is the fleet MINIMUM of the published
+        counts — every rank of a heterogeneous job reaches the identical
+        decision (a local cpu_count would mute-deadlock mixed hosts)."""
+        from tpu_dist.collectives import topology as T
+        monkeypatch.delenv("TPU_DIST_ALGO_CORES", raising=False)
+        topo = T.Topology(["a", "a", "b", "b"], [1, 1, 16, 16])
+        assert T.select_algo(8 << 20, topo=topo) == ("flat", False)
+        roomy = T.Topology(["a", "a", "b", "b"], [16, 16, 16, 16])
+        assert T.select_algo(8 << 20, topo=roomy) == ("hier", True)
+
+    def test_host_record_roundtrip_and_legacy(self):
+        from tpu_dist.collectives import topology as T
+
+        class _Store:
+            def __init__(self):
+                self.kv = {}
+
+            def set(self, k, v):
+                self.kv[k] = v
+
+        s = _Store()
+        T.publish_host_fingerprint(s, 3, 7)
+        (raw,) = s.kv.values()
+        host, cores = T.parse_host_record(raw)
+        assert host == T.host_fingerprint(3) and cores >= 1
+        assert T.parse_host_record(b"bare-fingerprint") == \
+            ("bare-fingerprint", None)
+
+    def test_algo_counters_record_choices(self):
+        from tpu_dist.collectives import topology as T
+        T.reset_algo_counters()
+        T.record_algo("all_reduce", "hier")
+        T.record_algo("all_reduce", "hier")
+        T.record_algo("all_reduce", "flat")
+        c = T.algo_counters(reset=True)
+        assert c == {"all_reduce/hier": 2, "all_reduce/flat": 1}
+        assert T.algo_counters() == {}
+
+
+# ---------------------------------------------------------------------------
+# spawned e2e: eager sub-group collectives + SHM peer death
+# ---------------------------------------------------------------------------
+
+_WORKER_PRELUDE = textwrap.dedent("""
+    import json, os, sys
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import importlib
+    import numpy as np
+    rank = int(os.environ["RANK"]); world = int(os.environ["WORLD_SIZE"])
+    from tpu_dist.dist.store import TCPStore
+    host, _, port = os.environ["TPU_DIST_STORE_ADDR"].rpartition(":")
+    store = TCPStore(host, int(port))
+    rdzv = importlib.import_module("tpu_dist.dist.rendezvous")
+    rdzv._store = store
+
+    class _Group:
+        def __init__(self, rank, num_processes):
+            self.rank, self.num_processes = rank, num_processes
+    g = _Group(rank, world)
+    from tpu_dist import collectives as C
+    os.environ["TPU_DIST_DP_THRESHOLD"] = "0"
+
+    def finish(payload):
+        with open(sys.argv[1] + f"/result{rank}.json", "w") as f:
+            json.dump(payload, f)
+        store.close()
+        sys.exit(0)
+""")
+
+# eager collectives scoped to a sub-group: members reduce among themselves
+# while outsiders run a DIFFERENT group — values and key namespaces never
+# cross; a non-member touching the group raises the named error
+_SUBGROUP_EAGER_WORKER = _WORKER_PRELUDE + textwrap.dedent("""
+    import hashlib
+    lo = C.new_group([0, 1], group=g)
+    hi = C.new_group([2, 3], group=g)
+    mine, other = (lo, hi) if rank < 2 else (hi, lo)
+    x = np.full(50021, float(rank + 1), np.float32)
+    out = C.all_reduce_host(x, group=mine, op="sum")
+    expect = (1.0 + 2.0) if rank < 2 else (3.0 + 4.0)
+    np.testing.assert_allclose(out, np.full(50021, expect, np.float32))
+    ag = C.all_gather_host(np.float32(rank), group=mine)
+    base = 0.0 if rank < 2 else 2.0
+    np.testing.assert_allclose(ag, np.asarray([base, base + 1], np.float32))
+    try:
+        C.all_reduce_host(x, group=other, op="sum")
+        err = None
+    except C.GroupMembershipError as e:
+        err = "GroupMembershipError"
+    dig = hashlib.sha256(np.ascontiguousarray(out).tobytes()).hexdigest()
+    store.barrier(world, tag="done", timeout=60)
+    finish({"err": err, "digest": dig})
+""")
+
+# chaos: rank 1 dies MID-collective with SHM lanes active (both ranks on
+# one simulated host); the survivor must get a named PeerGoneError through
+# the lane's liveness probe — not a hang
+_SHM_PEER_DEATH_WORKER = _WORKER_PRELUDE + textwrap.dedent("""
+    from tpu_dist.collectives import transport
+    dp = transport.get_data_plane(store, rank, world)
+    assert dp is not None
+    x = np.random.default_rng(rank).standard_normal(1 << 20) \\
+        .astype(np.float32)   # 4 MiB: many sub-chunk frames per ring step
+    if rank == 1:
+        # send the FIRST sub-chunk of a ring step, then die: rank 0 has
+        # frames owed and an SHM lane mid-stream
+        from tpu_dist.collectives import ring
+        step = 256 * 1024 // 4
+        dp.send_array(0, "har", x[:step])
+        assert dp.shm_active(0), "test wants the death on the SHM path"
+        os._exit(1)
+    from tpu_dist.collectives import ring
+    try:
+        ring.ring_all_reduce(dp, x, op="sum", tag="h")
+        finish({"err": None})
+    except transport.PeerGoneError as e:
+        finish({"err": "PeerGoneError", "named": "rank 1" in str(e)})
+""")
+
+
+def _spawn_world(tmp_path, source, world, env_extra=None, timeout=180,
+                 allow_rc=()):
+    from tpu_dist.dist.store import TCPStore
+    script = tmp_path / "worker.py"
+    script.write_text(source)
+    server = TCPStore(is_master=True)
+    env = dict(os.environ,
+               PYTHONPATH=_REPO + os.pathsep + os.environ.get("PYTHONPATH",
+                                                              ""),
+               JAX_PLATFORMS="cpu",
+               TPU_DIST_STORE_ADDR=f"127.0.0.1:{server.port}",
+               WORLD_SIZE=str(world), **(env_extra or {}))
+    env.pop("TPU_DIST_RESTART_COUNT", None)
+    env.pop("TPU_DIST_DP_THRESHOLD", None)
+    try:
+        procs = [subprocess.Popen(
+            [sys.executable, str(script), str(tmp_path)],
+            env=dict(env, RANK=str(r)), cwd=_REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+            for r in range(world)]
+        outs = [p.communicate(timeout=timeout) for p in procs]
+        rcs = [p.returncode for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        server.close()
+    bad = [r for r, rc in enumerate(rcs) if rc != 0 and r not in allow_rc]
+    assert not bad, "\n\n".join(
+        f"rank {r} rc={rcs[r]}\nstdout:\n{outs[r][0]}\nstderr:\n{outs[r][1]}"
+        for r in bad)
+    return [json.loads((tmp_path / f"result{r}.json").read_text())
+            if (tmp_path / f"result{r}.json").exists() else None
+            for r in range(world)]
+
+
+@pytest.mark.multiprocess
+def test_eager_subgroup_collectives_e2e(tmp_path):
+    res = _spawn_world(tmp_path, _SUBGROUP_EAGER_WORKER, 4,
+                       env_extra={"TPU_DIST_HOST_ID": "one-box"})
+    assert all(r["err"] == "GroupMembershipError" for r in res)
+    assert res[0]["digest"] == res[1]["digest"]
+    assert res[2]["digest"] == res[3]["digest"]
+    assert res[0]["digest"] != res[2]["digest"]
+
+
+@pytest.mark.multiprocess
+@pytest.mark.chaos
+def test_shm_peer_death_names_rank_not_hang(tmp_path):
+    res = _spawn_world(tmp_path, _SHM_PEER_DEATH_WORKER, 2,
+                       env_extra={"TPU_DIST_HOST_ID": "one-box",
+                                  "TPU_DIST_DP_TIMEOUT": "60"},
+                       timeout=120, allow_rc=(1,))
+    assert res[0] == {"err": "PeerGoneError", "named": True}

@@ -26,6 +26,13 @@ deadlocking example per rule):
 - **TD006** — inconsistent lock-acquisition order inside one module (lock
   A taken under B in one place, B under A in another): the ABBA deadlock
   pattern for transport-style modules full of fine-grained locks.
+- **TD008** — sub-group hazards (ROADMAP item 5's sub-group collectives
+  rule): a ``new_group(...)`` member list computed from this rank's
+  identity (every rank builds a DIFFERENT group — ids, store scopes and
+  wire tags can never match), or a collective issued on a literal
+  sub-group with no rank/membership guard (non-member ranks reach the
+  call and die on ``GroupMembershipError`` — or deadlock the members if
+  only some ranks guard).
 - **TD007** — async collective ``Work`` handle dropped without ``wait()``:
   a bare-expression call with ``async_op=True`` (the handle is discarded
   on the spot), or a handle assigned to a name that is never used again.
@@ -141,20 +148,48 @@ def _mentions_rank(expr: ast.AST) -> bool:
     return False
 
 
-def _collective_sequence(stmts: Sequence[ast.stmt]) -> List[ast.Call]:
+def _subgroup_names(tree: ast.AST) -> frozenset:
+    """Names bound from ``new_group(...)`` anywhere in the module.
+    Collectives scoped ``group=<one of these>`` are *expected* to sit
+    under rank/membership guards (only members call them), so TD001/TD002
+    leave them to TD008's membership analysis."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call) \
+                and _terminal_name(node.value.func) == "new_group":
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+    return frozenset(names)
+
+
+def _subgroup_scoped(call: ast.Call, skip: frozenset) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "group" and isinstance(kw.value, ast.Name) \
+                and kw.value.id in skip:
+            return True
+    return False
+
+
+def _collective_sequence(stmts: Sequence[ast.stmt],
+                         skip: frozenset = frozenset()) -> List[ast.Call]:
     """All collective Call nodes in the statements' subtrees, in source
-    order (the *sequence* every rank must agree on)."""
+    order (the *sequence* every rank must agree on).  Sub-group-scoped
+    calls (``skip``) are excluded — their agreement set is the group's
+    members, not every rank reaching this code."""
     calls = []
     for stmt in stmts:
         for node in ast.walk(stmt):
             if (isinstance(node, ast.Call)
-                    and _terminal_name(node.func) in COLLECTIVE_CALLS):
+                    and _terminal_name(node.func) in COLLECTIVE_CALLS
+                    and not _subgroup_scoped(node, skip)):
                 calls.append(node)
     calls.sort(key=lambda c: (c.lineno, c.col_offset))
     return calls
 
 
-def _canonical_names(stmts: Sequence[ast.stmt]) -> List[str]:
+def _canonical_names(stmts: Sequence[ast.stmt],
+                     skip: frozenset = frozenset()) -> List[str]:
     """Collective-call name sequence a rank EXECUTES through these
     statements: a nested conditional whose branches contribute identical
     sequences counts once (either path makes the same calls), so
@@ -163,22 +198,23 @@ def _canonical_names(stmts: Sequence[ast.stmt]) -> List[str]:
     conditional gets its own TD001/TD002 visit anyway."""
     out: List[str] = []
     for stmt in stmts:
-        out.extend(_canonical_names_node(stmt))
+        out.extend(_canonical_names_node(stmt, skip))
     return out
 
 
-def _canonical_names_node(node: ast.AST) -> List[str]:
+def _canonical_names_node(node: ast.AST,
+                          skip: frozenset = frozenset()) -> List[str]:
     if isinstance(node, ast.If):
-        test = _canonical_names_node(node.test)
-        body = _canonical_names(node.body)
-        orelse = _canonical_names(node.orelse)
+        test = _canonical_names_node(node.test, skip)
+        body = _canonical_names(node.body, skip)
+        orelse = _canonical_names(node.orelse, skip)
         return test + (body if body == orelse else body + orelse)
     out: List[str] = []
     for child in ast.iter_child_nodes(node):
-        out.extend(_canonical_names_node(child))
+        out.extend(_canonical_names_node(child, skip))
     if isinstance(node, ast.Call):
         name = _terminal_name(node.func)
-        if name in COLLECTIVE_CALLS:
+        if name in COLLECTIVE_CALLS and not _subgroup_scoped(node, skip):
             out.append(name)  # after children: argument-evaluation order
     return out
 
@@ -203,15 +239,16 @@ def _branch_terminates(stmts: Sequence[ast.stmt]) -> bool:
 
 def _check_rank_if(test: ast.expr, body: Sequence[ast.stmt],
                    orelse: Sequence[ast.stmt], path: str,
-                   out: List[Finding]) -> None:
+                   out: List[Finding],
+                   skip: frozenset = frozenset()) -> None:
     # canonical sequences decide consistency (nested same-on-both-sides
     # conditionals count once); raw Call nodes locate the TD001 findings
-    names_body = _canonical_names(body)
-    names_else = _canonical_names(orelse)
+    names_body = _canonical_names(body, skip)
+    names_else = _canonical_names(orelse, skip)
     if names_body == names_else:
         return  # both sides run the same collective sequence: consistent
-    seq_body = _collective_sequence(body)
-    seq_else = _collective_sequence(orelse)
+    seq_body = _collective_sequence(body, skip)
+    seq_else = _collective_sequence(orelse, skip)
     if names_body and names_else:
         out.append(Finding(
             "TD002", "error", path, test.lineno, test.col_offset,
@@ -230,24 +267,27 @@ def _check_rank_if(test: ast.expr, body: Sequence[ast.stmt],
 
 def rule_td001_td002(tree: ast.AST, path: str) -> List[Finding]:
     out: List[Finding] = []
+    skip = _subgroup_names(tree)
     for node in ast.walk(tree):
         if isinstance(node, ast.If) and _mentions_rank(node.test):
-            _check_rank_if(node.test, node.body, node.orelse, path, out)
+            _check_rank_if(node.test, node.body, node.orelse, path, out,
+                           skip)
         elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
                                ast.For, ast.While, ast.With)):
             # rank-conditional EARLY RETURN: `if rank != 0: return` followed
             # by collectives — the remaining ranks block in them forever
-            _check_early_exit(node.body, path, out)
+            _check_early_exit(node.body, path, out, skip)
     return out
 
 
 def _check_early_exit(stmts: Sequence[ast.stmt], path: str,
-                      out: List[Finding]) -> None:
+                      out: List[Finding],
+                      skip: frozenset = frozenset()) -> None:
     for i, stmt in enumerate(stmts):
         if (isinstance(stmt, ast.If) and _mentions_rank(stmt.test)
                 and not stmt.orelse and _branch_terminates(stmt.body)
-                and not _collective_sequence(stmt.body)):
-            for call in _collective_sequence(stmts[i + 1:]):
+                and not _collective_sequence(stmt.body, skip)):
+            for call in _collective_sequence(stmts[i + 1:], skip):
                 out.append(Finding(
                     "TD001", "error", path, call.lineno, call.col_offset,
                     f"collective {_terminal_name(call.func)}() is only "
@@ -627,6 +667,100 @@ def rule_td007(tree: ast.AST, path: str) -> List[Finding]:
     return out
 
 
+# -- TD008: sub-group construction / membership hazards -----------------------
+#
+# new_group() (tpu_dist/collectives/topology.py, the torch new_group
+# analogue) must be called by EVERY rank with the IDENTICAL member list —
+# the group id that namespaces store keys and wire tags derives from it, so
+# rank-divergent lists mint divergent groups whose collectives can never
+# match.  And a collective issued on a literal sub-group without any
+# rank/membership guard runs on ranks that may not be members, which the
+# runtime rejects (GroupMembershipError) — or worse, desynchronizes the
+# members if the guard exists on some ranks only.
+
+
+def _membership_guarded(parents: Dict[ast.AST, ast.AST], node: ast.AST,
+                        group_name: str) -> bool:
+    """True when an enclosing ``if`` tests rank-ness or the group object
+    itself (``if rank in members:``, ``if g.rank is not None:``,
+    ``if me in g.members:``, ...) — the caller is gating on membership."""
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.If):
+            test = cur.test
+            if _mentions_rank(test):
+                return True
+            for sub in ast.walk(test):
+                if isinstance(sub, ast.Name) and sub.id == group_name:
+                    return True
+        cur = parents.get(cur)
+    return False
+
+
+def rule_td008(tree: ast.AST, path: str) -> List[Finding]:
+    out: List[Finding] = []
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+
+    # (a) member list computed from this rank's identity: every rank gets a
+    # DIFFERENT group — keys/tags/sanitizer scopes can never line up
+    literal_groups: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and _terminal_name(node.func) == "new_group"):
+            continue
+        member_args = list(node.args) + [kw.value for kw in node.keywords
+                                         if kw.arg == "ranks"]
+        for arg in member_args:
+            if _mentions_rank(arg):
+                out.append(Finding(
+                    "TD008", "error", path, node.lineno, node.col_offset,
+                    f"new_group member list `{_src(arg)}` depends on this "
+                    f"process's rank: every rank must pass the IDENTICAL "
+                    f"list (torch new_group semantics) — rank-divergent "
+                    f"lists mint divergent group ids whose collectives "
+                    f"deadlock instead of matching"))
+        # remember names bound to groups with fully-literal member lists
+        # for the membership check below
+        assign = parents.get(node)
+        if isinstance(assign, ast.Assign) and member_args:
+            m = member_args[0]
+            if isinstance(m, (ast.List, ast.Tuple)) and all(
+                    isinstance(e, ast.Constant) for e in m.elts):
+                for t in assign.targets:
+                    if isinstance(t, ast.Name):
+                        literal_groups[t.id] = node.lineno
+
+    # (b) collective on a literal sub-group with no rank/membership guard:
+    # non-member ranks reaching this call either die on
+    # GroupMembershipError or (guarded on SOME ranks only) desynchronize
+    # the members
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and _terminal_name(node.func) in COLLECTIVE_CALLS):
+            continue
+        for kw in node.keywords:
+            if kw.arg != "group" or not isinstance(kw.value, ast.Name):
+                continue
+            gname = kw.value.id
+            if gname not in literal_groups:
+                continue
+            if _membership_guarded(parents, node, gname):
+                continue
+            out.append(Finding(
+                "TD008", "warning", path, node.lineno, node.col_offset,
+                f"collective {_terminal_name(node.func)}(group={gname}) on "
+                f"the sub-group built at line {literal_groups[gname]} has "
+                f"no rank/membership guard: ranks outside the member list "
+                f"reach this call too — gate it (e.g. `if rank in "
+                f"members:` / `if {gname}.rank is not None:`) or run it on "
+                f"every rank of a group they are all members of"))
+    out.sort(key=lambda f: (f.line, f.col))
+    return out
+
+
 # -- registry -----------------------------------------------------------------
 
 RULES = {
@@ -636,6 +770,7 @@ RULES = {
     "TD005": rule_td005,
     "TD006": rule_td006,
     "TD007": rule_td007,
+    "TD008": rule_td008,
 }
 
 RULE_DOCS = {
@@ -649,6 +784,9 @@ RULE_DOCS = {
     "TD006": "inconsistent lock-acquisition order within a module",
     "TD007": "async collective Work handle dropped without wait()/"
              "wait_all()",
+    "TD008": "sub-group built from a rank-divergent member list, or a "
+             "collective issued on a group the caller may not be a "
+             "member of",
 }
 
 

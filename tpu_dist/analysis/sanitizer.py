@@ -7,11 +7,18 @@ collective first publishes a per-call **signature** to the
 generation-scoped control-plane store and cross-checks agreement across
 ranks before any payload moves:
 
-    {ns}/san/{seq}/{rank}  ->  {"op": "all_reduce", "reduce": "sum",
+    {ns}/san[/grp{set}]/{seq}/{rank}  ->  {"op": "all_reduce",
+                               "reduce": "sum",
                                "tree": "<structure hash>",
                                "leaves": [["float32", [1024]], ...],
                                "src"/"dst": ...,
+                               "group": "world[4]" | "grp<id>[0, 1]",
                                "site": "train.py:123", "rank": 2}
+
+Sub-group collectives (tpu_dist/collectives/topology.py) post under a
+scope derived from the member *set* and sign the group id + the exact
+ordered membership, so mismatched group objects raise naming BOTH
+memberships (see ``_group_sig``); each scope counts its own ``seq``.
 
 ``seq`` is a process-local counter: in an SPMD program every rank arrives
 at sanitized collective #seq together, so the keys line up.  Each rank
@@ -54,9 +61,18 @@ __all__ = ["CollectiveMismatchError", "enabled", "check_collective",
 # cast or an int8 block-quant spec): ranks running different schemes would
 # exchange frames in different wire formats and corrupt the ring, so a
 # skewed compression config fails here naming both schemes instead.
-SEMANTIC_FIELDS = ("op", "reduce", "tree", "leaves", "src", "dst", "comm")
+# "group" is the SubGroup identity (group_id + the ordered membership):
+# ranks whose group objects diverge — different ring order, different
+# members, or a sub-group vs the flat world — would run incompatible rings
+# over colliding tags; the signature names BOTH memberships before any
+# payload moves.
+SEMANTIC_FIELDS = ("op", "reduce", "tree", "leaves", "src", "dst", "comm",
+                   "group")
 
-_seq = 0  # process-local sanitized-collective counter
+# process-local sanitized-collective counters, one per signature scope:
+# every group (and the flat world) counts its own collectives, because a
+# rank participates in different subsets of each group's traffic
+_seqs: Dict[str, int] = {}
 
 
 class CollectiveMismatchError(RuntimeError):
@@ -89,9 +105,29 @@ def _timeout() -> float:
 
 
 def reset() -> None:
-    """Restart the sanitized-call counter (tests / re-init)."""
-    global _seq
-    _seq = 0
+    """Restart the sanitized-call counters (tests / re-init)."""
+    _seqs.clear()
+
+
+def _group_sig(group):
+    """``(scope_segment, group_field)`` for a collective's group.
+
+    ``scope_segment`` namespaces the signature keys.  It hashes the
+    *sorted member set*, NOT the ordered list: two ranks holding groups
+    that diverge only in ring order / id still post into the SAME
+    keyspace, so the divergence is diagnosed as a named mismatch (the
+    ``group`` field below differs) rather than a mute deadline.  Groups
+    over different member sets can never rendezvous at all — those fail
+    via the deadline, naming the ranks that never announced.
+
+    ``group_field`` is the compared signature value: the group id plus
+    the exact ordered membership — the error therefore NAMES both
+    memberships."""
+    gid = getattr(group, "group_id", None)
+    if gid is None:
+        return "", f"world[{group.num_processes}]"
+    set_scope = getattr(group, "set_scope", gid)
+    return f"/grp{set_scope}", f"grp{gid}{list(group.members)}"
 
 
 def _call_site() -> str:
@@ -152,19 +188,28 @@ def check_collective(group, store, op: str, value: Any = None,
     Called by the eager collectives (tpu_dist/collectives/eager.py) before
     any payload moves; safe to call directly around custom store-based
     synchronization as well."""
-    global _seq
-    n, me = group.num_processes, group.rank
+    n = group.num_processes
     if store is None or n <= 1:
         return
-    seq, _seq = _seq, _seq + 1
+    scope, group_field = _group_sig(group)
+    # signature keys carry GLOBAL rank identity: two ranks holding groups
+    # that diverge in ring order would collide on group-local ranks (both
+    # think they are local rank 0) and mis-wait — global ids keep the
+    # rendezvous honest, so order divergence is compared and NAMED
+    members = getattr(group, "members", None)
+    me = group.parent_rank if members is not None else group.rank
+    peers = ([r for r in members if r != me] if members is not None
+             else [r for r in range(n) if r != me])
+    seq = _seqs.get(scope, 0)
+    _seqs[scope] = seq + 1
     mine = _signature(op, me, value=value, reduce_op=reduce_op, src=src,
                       dst=dst, comm=comm, with_leaves=with_leaves)
-    base = f"{_ns()}/{seq}"
+    mine["group"] = group_field
+    base = f"{_ns()}{scope}/{seq}"
     store.set(f"{base}/{me}", json.dumps(mine, sort_keys=True).encode())
 
     timeout = _timeout()
     deadline = time.monotonic() + timeout
-    peers = [r for r in range(n) if r != me]
     waiting = set(peers)
     delay = 0.0005
     while waiting:

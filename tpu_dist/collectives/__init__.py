@@ -53,6 +53,13 @@ from .bucketer import (Bucketer, BucketWork, bucketed_all_reduce,
 # int8_block256, Bucketer/ZeroOptimizer comm_dtype=...)
 from . import quant
 from .quant import ErrorFeedback, QuantScheme
+# topology-aware collectives: host detection, scoped sub-groups
+# (torch new_group analogue), the two-level hierarchical ring over
+# shared-memory intra-host lanes (.shm), and algorithm autoselection
+# (TPU_DIST_ALGO: auto | flat | hier | store)
+from . import shm, topology
+from .topology import (GroupMembershipError, SubGroup, Topology,
+                       detect_topology, hier_all_reduce, new_group)
 
 __all__ = [
     "all_reduce", "all_gather", "reduce_scatter", "broadcast", "all_to_all",
@@ -66,4 +73,6 @@ __all__ = [
     "work", "Work", "wait_all", "bucketer", "Bucketer", "BucketWork",
     "bucketed_all_reduce", "bucketed_reduce_scatter",
     "quant", "QuantScheme", "ErrorFeedback",
+    "shm", "topology", "Topology", "SubGroup", "GroupMembershipError",
+    "new_group", "detect_topology", "hier_all_reduce",
 ]

@@ -106,7 +106,44 @@ def _default_group(group):
     if group is None:
         from ..dist import get_default_group
         group = get_default_group()
+    elif getattr(group, "rank", 0) is None:
+        # a SubGroup held by a non-member: collectives on it must fail
+        # loudly BEFORE any payload/signature moves (tpudlint TD008's
+        # runtime complement) — a non-member joining would desynchronize
+        # every member's ring tags and sanitizer sequence
+        group.require_member()
     return group
+
+
+def _group_id(group) -> Optional[str]:
+    """The SubGroup id, or None for the flat world (default group /
+    ProcessGroup shims)."""
+    return getattr(group, "group_id", None)
+
+
+def _group_scope(group) -> str:
+    """Store-key namespace segment for a scoped sub-group: sequence
+    counters are per group, so two groups' collective keys (and the
+    default group's) can never collide."""
+    gid = _group_id(group)
+    return f"/grp{gid}" if gid else ""
+
+
+def _use_mesh(group, store) -> bool:
+    """Whether this collective should ride the XLA mesh collectives.
+    Sub-groups can never: ``multihost_utils`` spans the whole world, so a
+    scoped collective on the mesh path would involve non-members.  A
+    SubGroup without a control-plane store is a configuration error,
+    named here rather than hung in XLA."""
+    if _group_id(group) is not None:
+        if store is None:
+            raise RuntimeError(
+                "sub-group collectives need the control-plane store "
+                "(bring the job up via tpu_dist.launch or set "
+                "TPU_DIST_STORE_ADDR): the mesh collectives cannot scope "
+                f"to {group.describe()}")
+        return False
+    return store is None or _prefer_mesh(group)
 
 
 # -- async engine glue (tpu_dist/collectives/work.py) -------------------------
@@ -204,10 +241,11 @@ def all_reduce_host(x, group=None, op: str = ReduceOp.SUM,
 
 
 def _all_reduce_body(x, group, op, fn):
-    with _obs_span("all_reduce", value=x, reduce_op=op):
+    with _obs_span("all_reduce", value=x, reduce_op=op,
+                   group=_group_id(group)):
         store = _coll_store()
         _sanitize("all_reduce", group, store, value=x, reduce_op=op)
-        if store is None or _prefer_mesh(group):
+        if _use_mesh(group, store):
             _obs_mesh()
             from jax.experimental import multihost_utils
             gathered = multihost_utils.process_allgather(x)  # lead axis=proc
@@ -217,12 +255,14 @@ def _all_reduce_body(x, group, op, fn):
 
 def _routed_all_reduce(x, group, store, op, fn):
     from . import ring as _ring
+    from . import topology as _topo
     n = group.num_processes
     leaves, treedef = jax.tree.flatten(x)
     arrs = [np.asarray(l) for l in leaves]
     opl = str(op).lower()
-    seq = _next_seq("allreduce", 0)
-    base = f"{_ns()}/coll/ar/{seq}"
+    scope = _group_scope(group)
+    seq = _next_seq(f"allreduce{scope}", 0)
+    base = f"{_ns()}{scope}/coll/ar/{seq}"
     ring_idx, small, dp = _partition_and_dp(arrs, group, store, opl)
     out = [None] * len(arrs)
     if small:
@@ -233,13 +273,33 @@ def _routed_all_reduce(x, group, store, op, fn):
             out[i] = fn(np.stack([np.asarray(rows[r][pos])
                                   for r in range(n)]))
         _record("all_reduce", "store", sum(arrs[i].nbytes for i in small), t0)
+        _topo.record_algo("all_reduce", "store")
     comm = _comm_dtype()
+    in_group = _group_id(group) is not None
     for j, i in enumerate(ring_idx):
         t0 = time.perf_counter()
         stats: dict = {}
-        out[i] = _ring.ring_all_reduce(dp, arrs[i], op=opl,
-                                       tag=f"{base}/{j}", comm_dtype=comm,
-                                       stats=stats)
+        # per-leaf algorithm selection (flat vs two-level ring, and the
+        # compute-bound f32 fallback) — the decision depends only on
+        # payload size + store-agreed topology + launcher-uniform env, so
+        # every rank picks the same algorithm.  Inside a SubGroup the ring
+        # already runs over the group's own order: stay flat there.
+        if in_group:
+            algo, comm_ok = "flat", True
+        else:
+            algo, comm_ok = _topo.select_algo(arrs[i].nbytes, dp=dp)
+        leaf_comm = comm if comm_ok else None
+        _topo.record_algo("all_reduce", algo)
+        if algo == "hier":
+            out[i] = _topo.hier_all_reduce(dp, arrs[i], op=opl,
+                                           tag=f"{base}/{j}",
+                                           comm_dtype=leaf_comm,
+                                           stats=stats)
+        else:
+            out[i] = _ring.ring_all_reduce(dp, arrs[i], op=opl,
+                                           tag=f"{base}/{j}",
+                                           comm_dtype=leaf_comm,
+                                           stats=stats)
         _record("all_reduce", "dataplane", arrs[i].nbytes, t0,
                 wire_bytes=stats.get("wire_bytes"),
                 raw_wire_bytes=stats.get("raw_wire_bytes"))
@@ -266,10 +326,10 @@ def all_gather_host(x, group=None, async_op: bool = False):
 
 
 def _all_gather_body(x, group):
-    with _obs_span("all_gather", value=x):
+    with _obs_span("all_gather", value=x, group=_group_id(group)):
         store = _coll_store()
         _sanitize("all_gather", group, store, value=x)
-        if store is None or _prefer_mesh(group):
+        if _use_mesh(group, store):
             _obs_mesh()
             from jax.experimental import multihost_utils
             return multihost_utils.process_allgather(x)
@@ -281,8 +341,9 @@ def _routed_all_gather(x, group, store):
     n = group.num_processes
     leaves, treedef = jax.tree.flatten(x)
     arrs = [np.asarray(l) for l in leaves]
-    seq = _next_seq("allgather", 0)
-    base = f"{_ns()}/coll/ag/{seq}"
+    scope = _group_scope(group)
+    seq = _next_seq(f"allgather{scope}", 0)
+    base = f"{_ns()}{scope}/coll/ag/{seq}"
     ring_idx, small, dp = _partition_and_dp(arrs, group, store)
     out = [None] * len(arrs)
     if small:
@@ -329,10 +390,10 @@ def broadcast_host(x, group=None, src: int = 0, async_op: bool = False):
 
 
 def _broadcast_body(x, group, src):
-    with _obs_span("broadcast", value=x, src=src):
+    with _obs_span("broadcast", value=x, src=src, group=_group_id(group)):
         store = _coll_store()
         _sanitize("broadcast", group, store, value=x, src=src)
-        if store is None or _prefer_mesh(group):
+        if _use_mesh(group, store):
             _obs_mesh()
             from jax.experimental import multihost_utils
             return multihost_utils.broadcast_one_to_all(
@@ -346,8 +407,9 @@ def _routed_broadcast(x, group, store, src):
     n, me = group.num_processes, group.rank
     leaves, treedef = jax.tree.flatten(x)
     arrs = [np.asarray(l) for l in leaves]
-    seq = _next_seq("bcast", src)
-    base = f"{_ns()}/coll/bc/{seq}"
+    scope = _group_scope(group)
+    seq = _next_seq(f"bcast{scope}", src)
+    base = f"{_ns()}{scope}/coll/bc/{seq}"
     tree_idx, small, dp = _partition_and_dp(arrs, group, store)
     out = [None] * len(arrs)
     if small:
@@ -392,10 +454,11 @@ def reduce_host(x, dst: int = 0, group=None, op: str = ReduceOp.SUM):
     if group.num_processes <= 1:
         return jax.tree.map(np.asarray, x)
     _drain_async()
-    with _obs_span("reduce", value=x, reduce_op=op, dst=dst):
+    with _obs_span("reduce", value=x, reduce_op=op, dst=dst,
+                   group=_group_id(group)):
         store = _coll_store()
         _sanitize("reduce", group, store, value=x, reduce_op=op, dst=dst)
-        if store is not None and not _prefer_mesh(group):
+        if not _use_mesh(group, store):
             # rooted: ride the O(1)-per-rank store gather; only dst reduces
             gathered = gather_host(x, dst=dst, group=group)
             if gathered is None:
@@ -441,8 +504,8 @@ def _ns() -> str:
     return f"tpu_dist/g{rdzv.generation()}"
 
 
-def _coll_key(op: str, root: int, seq: int, peer: int) -> str:
-    return f"{_ns()}/coll/{op}/{root}/{seq}/{peer}"
+def _coll_key(op: str, root: int, seq: int, peer: int, group=None) -> str:
+    return f"{_ns()}{_group_scope(group)}/coll/{op}/{root}/{seq}/{peer}"
 
 
 def _tree_to_bytes(tree) -> bytes:
@@ -523,16 +586,23 @@ def _maybe_data_plane(group, store):
     if _host_transport_is_store_only():
         return None
     from . import transport
+    # a SubGroup rides the PROCESS's data plane (global rank space) through
+    # its group-scoped view — group-local ranks and namespaced wire tags
+    sub = _group_id(group) is not None
+    rank = group.parent_rank if sub else group.rank
+    world = group.parent_world if sub else group.num_processes
     try:
-        return transport.get_data_plane(store, group.rank,
-                                        group.num_processes)
+        dp = transport.get_data_plane(store, rank, world)
     except Exception as e:
         raise RuntimeError(
-            f"rank {group.rank}: p2p data-plane setup failed ({e!r}); "
+            f"rank {rank}: p2p data-plane setup failed ({e!r}); "
             f"failing fast rather than degrading one-sidedly (peers would "
             f"deadlock routing this rank's payloads to the ring).  Set "
             f"TPU_DIST_NO_DATAPLANE=1 on ALL ranks to run store-only."
         ) from e
+    if dp is not None and sub:
+        return group.view(dp)
+    return dp
 
 
 def _prefer_mesh(group) -> bool:
@@ -572,6 +642,9 @@ def _dp_leaf_ok(a: np.ndarray, reduce_op: Optional[str] = None) -> bool:
     arithmetic; broadcast/gather only move bytes)."""
     if not _dp_enabled() or a.nbytes < _dp_threshold():
         return False
+    from . import topology as _topo
+    if _topo.algo_mode() == "store":
+        return False  # TPU_DIST_ALGO=store: bypass the data plane entirely
     dt = a.dtype
     if reduce_op is not None:
         from . import ring as _ring
@@ -616,12 +689,15 @@ def _record(op: str, path: str, nbytes: int, t0: float,
 
 
 def _obs_span(op: str, value=None, reduce_op=None, src=None, dst=None,
-              peer=None, kind: str = "collective"):
+              peer=None, kind: str = "collective", group=None):
     """Flight-recorder span around one eager collective (tpu_dist.obs);
-    disarmed -> a shared no-op context, one env lookup."""
+    disarmed -> a shared no-op context, one env lookup.  ``group`` is the
+    SubGroup id for scoped collectives (None = the flat world) so spans
+    attribute to the group they ran in."""
     from ..obs import hooks as _hooks
     return _hooks.collective_span(op, value=value, reduce_op=reduce_op,
-                                  src=src, dst=dst, peer=peer, kind=kind)
+                                  src=src, dst=dst, peer=peer, kind=kind,
+                                  group=group)
 
 
 def _obs_mesh() -> None:
@@ -687,7 +763,7 @@ def gather_host(x, dst: int = 0, group=None) -> Optional[List]:
     if n <= 1:
         return [jax.tree.map(np.asarray, x)]
     _drain_async()
-    with _obs_span("gather", value=x, dst=dst):
+    with _obs_span("gather", value=x, dst=dst, group=_group_id(group)):
         return _gather_host(x, dst, group, n)
 
 
@@ -695,11 +771,13 @@ def _gather_host(x, dst, group, n):
     store = _coll_store()
     # no leaf signature: gather legitimately moves per-rank shapes
     _sanitize("gather", group, store, dst=dst)
+    if store is None:
+        _use_mesh(group, store)  # raises for sub-groups: store required
     if store is not None:
-        seq = _next_seq("gather", dst)
+        seq = _next_seq(f"gather{_group_scope(group)}", dst)
         t0 = time.perf_counter()
         if group.rank != dst:
-            store.set(_coll_key("gather", dst, seq, group.rank),
+            store.set(_coll_key("gather", dst, seq, group.rank, group),
                       _tree_to_bytes(x))
             return None
         # wait on ALL peer keys first (bounded), then fetch: the sequential
@@ -707,7 +785,7 @@ def _gather_host(x, dst, group, n):
         # rank happened to be slowest, in rank order, with no deadline —
         # this version has one wait for the stragglers and then drains the
         # already-posted payloads back-to-back
-        keys = [_coll_key("gather", dst, seq, r) for r in range(n)
+        keys = [_coll_key("gather", dst, seq, r, group) for r in range(n)
                 if r != dst]
         _wait_peer_keys(store, keys)
         out = []
@@ -716,7 +794,7 @@ def _gather_host(x, dst, group, n):
             if r == dst:
                 out.append(jax.tree.map(np.asarray, x))
             else:
-                key = _coll_key("gather", dst, seq, r)
+                key = _coll_key("gather", dst, seq, r, group)
                 raw = store.get(key)
                 nbytes += len(raw)
                 out.append(_tree_from_bytes(raw))
@@ -762,7 +840,8 @@ def scatter_host(output_template, scatter_list: Optional[List] = None,
     else:
         payload = None
     _drain_async()
-    with _obs_span("scatter", value=output_template, src=src):
+    with _obs_span("scatter", value=output_template, src=src,
+                   group=_group_id(group)):
         return _scatter_host(output_template, payload, src, group, n)
 
 
@@ -773,8 +852,10 @@ def _scatter_host(output_template, payload, src, group, n):
     # broadcast of the full list + local pick when no store is up.
     store = _coll_store()
     _sanitize("scatter", group, store, value=output_template, src=src)
+    if store is None:
+        _use_mesh(group, store)  # raises for sub-groups: store required
     if store is not None:
-        seq = _next_seq("scatter", src)
+        seq = _next_seq(f"scatter{_group_scope(group)}", src)
         t0 = time.perf_counter()
         if group.rank == src:
             nbytes = 0
@@ -782,10 +863,11 @@ def _scatter_host(output_template, payload, src, group, n):
                 if dst != src:
                     raw = _tree_to_bytes(payload[dst])
                     nbytes += len(raw)
-                    store.set(_coll_key("scatter", src, seq, dst), raw)
+                    store.set(_coll_key("scatter", src, seq, dst, group),
+                              raw)
             _record("scatter", "store", nbytes, t0)
             return payload[src]
-        key = _coll_key("scatter", src, seq, group.rank)
+        key = _coll_key("scatter", src, seq, group.rank, group)
         raw = store.get(key)       # blocks until src posts it
         store.delete_key(key)
         _record("scatter", "store", len(raw), t0)
@@ -891,14 +973,14 @@ def scatter_object_list(scatter_object_input_list: Optional[List[Any]] = None,
     store = _coll_store()
     if store is not None:
         # O(1)-per-rank: one store key per destination (see gather_host)
-        seq = _next_seq("scatter_obj", src)
+        seq = _next_seq(f"scatter_obj{_group_scope(group)}", src)
         if group.rank == src:
             for dst in range(n):
                 if dst != src:
-                    store.set(_coll_key("scatter_obj", src, seq, dst),
+                    store.set(_coll_key("scatter_obj", src, seq, dst, group),
                               pickle.dumps(scatter_object_input_list[dst]))
             return scatter_object_input_list[src]
-        key = _coll_key("scatter_obj", src, seq, group.rank)
+        key = _coll_key("scatter_obj", src, seq, group.rank, group)
         obj = pickle.loads(store.get(key))
         store.delete_key(key)
         return obj
@@ -927,33 +1009,35 @@ def all_to_all_host(input_list: List[Any], group=None) -> List[Any]:
     if n <= 1:
         return list(input_list)
     _drain_async()
-    with _obs_span("all_to_all", value=input_list):
+    with _obs_span("all_to_all", value=input_list, group=_group_id(group)):
         return _all_to_all_host(input_list, group, n)
 
 
 def _all_to_all_host(input_list, group, n):
     store = _coll_store()
     _sanitize("all_to_all", group, store)
+    if store is None:
+        _use_mesh(group, store)  # raises for sub-groups: store required
     if store is not None:
         # pairwise store keys: rank p moves only its row (sends) and its
         # column (receives) — not every rank x rank entry like the
         # all-gather fallback
         me = group.rank
-        seq = _next_seq("a2a", 0)
+        seq = _next_seq(f"a2a{_group_scope(group)}", 0)
         t0 = time.perf_counter()
         nbytes = 0
         for q in range(n):
             if q != me:
                 # plain pickle (object transport): entries may be arrays
                 # OR arbitrary objects — no np coercion on the wire
-                store.set(_coll_key("a2a", q, seq, me),
+                store.set(_coll_key("a2a", q, seq, me, group),
                           pickle.dumps(input_list[q]))
         out = []
         for r in range(n):
             if r == me:
                 out.append(input_list[me])
             else:
-                key = _coll_key("a2a", me, seq, r)
+                key = _coll_key("a2a", me, seq, r, group)
                 raw = store.get(key)
                 # count ONE direction (the fetched column), matching the
                 # per-rank convention of gather/scatter — counting sends
@@ -986,8 +1070,10 @@ def _p2p_store():
     return rdzv._store
 
 
-def _p2p_key(src: int, dst: int, tag: int, seq: int) -> str:
-    return f"{_ns()}/p2p/{src}->{dst}/t{tag}/{seq}"
+def _p2p_key(src: int, dst: int, tag: int, seq: int, group=None) -> str:
+    # group-scoped: a SubGroup's (group-local) rank pair must never match
+    # the flat world's store keys for the same numeric pair
+    return f"{_ns()}{_group_scope(group)}/p2p/{src}->{dst}/t{tag}/{seq}"
 
 
 def _p2p_wire_tag(tag: int, seq: int) -> str:
@@ -1032,7 +1118,7 @@ def _send_body(x, dst: int, group, tag: int) -> None:
     # the sequence number is consumed only on a successful handoff: a send
     # that raises (dead peer, store trouble) leaves the counter untouched,
     # so a caller that recovers and retries stays matched with the receiver
-    seq = _p2p_send_seq.get((me, dst, tag), 0)
+    seq = _p2p_send_seq.get((me, dst, tag, _group_id(group)), 0)
     arr = np.asarray(x)
     with _obs_span("send", value=arr, dst=dst, kind="p2p"):
         t0 = time.perf_counter()
@@ -1044,13 +1130,13 @@ def _send_body(x, dst: int, group, tag: int) -> None:
             dp = _maybe_data_plane(group, store)
             if dp is not None:
                 dp.send_array(dst, _p2p_wire_tag(tag, seq), arr)
-                _p2p_send_seq[(me, dst, tag)] = seq + 1
+                _p2p_send_seq[(me, dst, tag, _group_id(group))] = seq + 1
                 _record("send", "dataplane", arr.nbytes, t0)
                 return
         buf = io.BytesIO()
         np.save(buf, arr, allow_pickle=False)
-        store.set(_p2p_key(me, dst, tag, seq), buf.getvalue())
-        _p2p_send_seq[(me, dst, tag)] = seq + 1
+        store.set(_p2p_key(me, dst, tag, seq, group), buf.getvalue())
+        _p2p_send_seq[(me, dst, tag, _group_id(group))] = seq + 1
         _record("send", "store", arr.nbytes, t0)
 
 
@@ -1141,12 +1227,12 @@ def _recv(src: int, group, tag: int) -> np.ndarray:
     # seq consumed only on delivery (mirrors send): a recv that raises
     # (timeout, dead peer) leaves the counter untouched, so a retry waits
     # for the SAME in-flight message instead of desynchronizing by one
-    seq = _p2p_recv_seq.get((src, me, tag), 0)
-    key = _p2p_key(src, me, tag, seq)
+    seq = _p2p_recv_seq.get((src, me, tag, _group_id(group)), 0)
+    key = _p2p_key(src, me, tag, seq, group)
     t0 = time.perf_counter()
 
     def _delivered(out, path):
-        _p2p_recv_seq[(src, me, tag)] = seq + 1
+        _p2p_recv_seq[(src, me, tag, _group_id(group))] = seq + 1
         _record("recv", path, out.nbytes, t0)
         return out
 

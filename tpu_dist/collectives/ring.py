@@ -76,12 +76,25 @@ RING_OPS = frozenset({"sum", "avg", "mean", "max", "min"})
 _DEF_CHUNK = 256 * 1024  # wire frame payload bytes
 
 
-def _chunk_bytes() -> int:
+def _chunk_bytes(dp=None, dst: Optional[int] = None) -> int:
     try:
-        return max(4096, int(os.environ.get("TPU_DIST_DP_CHUNK",
+        base = max(4096, int(os.environ.get("TPU_DIST_DP_CHUNK",
                                             str(_DEF_CHUNK))))
     except ValueError:
-        return _DEF_CHUNK
+        base = _DEF_CHUNK
+    if dp is not None and dst is not None:
+        # per-destination grain: shared-memory lanes want far coarser
+        # frames than a slow wire (the transfer is a memcpy — pipelining
+        # buys nothing, per-frame overhead dominates).  Rank-local and
+        # value-free: frame segmentation never changes fold arithmetic,
+        # so peers need not agree on it.
+        hint = getattr(dp, "send_chunk_bytes", None)
+        if hint is not None:
+            try:
+                return max(4096, int(hint(dst, base)))
+            except Exception:
+                return base
+    return base
 
 
 def _bounds(n_elems: int, n: int):
@@ -174,7 +187,7 @@ def _send_span(dp, dst: int, tag: str, flat: np.ndarray, lo: int, hi: int,
     """Send flat[lo:hi] as sub-chunk frames; returns wire bytes sent."""
     if hi <= lo:
         return 0
-    step = max(1, _chunk_bytes() // flat.itemsize)
+    step = max(1, _chunk_bytes(dp, dst) // flat.itemsize)
     wb = 0
     for slo in range(lo, hi, step):
         seg = flat[slo:min(slo + step, hi)]
@@ -243,7 +256,7 @@ def _exchange(dp, right: int, left: int, tag: str, flat: np.ndarray,
     buffer, indexed like ``flat``) compensates exactly this per-hop loss:
     each outgoing segment sends ``compress(seg + residual)`` and keeps the
     new loss for the next step."""
-    step = max(1, _chunk_bytes() // flat.itemsize)
+    step = max(1, _chunk_bytes(dp, right) // flat.itemsize)
     sp, rp = send_lo, recv_lo
     wb = 0
     while sp < send_hi:
@@ -373,14 +386,15 @@ def _compress_owned(chunk: np.ndarray, wire, residual):
     return deq, frames
 
 
-def _split_quant(q: np.ndarray, scales: np.ndarray, scheme):
+def _split_quant(q: np.ndarray, scales: np.ndarray, scheme, dp=None,
+                 dst=None):
     """Split one whole-chunk quantization into wire frames at
     block-aligned boundaries, so each frame carries exactly its own
     scales.  Frame size tracks ``TPU_DIST_DP_CHUNK`` (the wire payload is
     ~1 byte per element)."""
     n = q.size
-    step = max(scheme.block,
-               _chunk_bytes() - _chunk_bytes() % scheme.block)
+    cb = _chunk_bytes(dp, dst)
+    step = max(scheme.block, cb - cb % scheme.block)
     frames = []
     for flo in range(0, n, step):
         fhi = min(flo + step, n)
@@ -421,7 +435,7 @@ def _ag_phase_quant(dp, flat, bounds, n, r, tag, scheme,
     chunk = np.array(flat[lo:hi])  # standalone: _compress_owned re-binds
     deq, qs = _compress_owned(chunk, scheme, residual)
     flat[lo:hi] = deq
-    frames = _split_quant(*qs, scheme) if qs is not None else []
+    frames = _split_quant(*qs, scheme, dp, right) if qs is not None else []
     wb = 0
     for step in range(n - 1):
         ri = (r - step - 1) % n

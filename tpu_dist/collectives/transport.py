@@ -31,7 +31,20 @@ Design notes:
   diagnosis instead of hanging until a multi-minute timeout (the same
   philosophy as the resilience layer's ``RankLostError``).
 
+- **Shared-memory lanes for co-located peers.**  Every rank publishes its
+  host fingerprint (tpu_dist/collectives/topology.py) next to its address;
+  a sender that discovers its destination on the same host sets up an SHM
+  payload lane (tpu_dist/collectives/shm.py) and announces it in-band on
+  the peer socket.  Frame *headers* — the exact same tag/dtype/shape
+  contract, including ``q8b{N}`` quant frames — keep riding TCP (ordering,
+  liveness, generation fencing unchanged); payload *bytes* move as two
+  memcpys through the shared ring instead of through the loopback TCP
+  stack.  TCP remains the fallback: ``TPU_DIST_SHM=0``, setup failure, or
+  a frame racing ahead of lane setup all ship inline, and the receiver
+  accepts both forms at any time.
+
 Env knobs: ``TPU_DIST_DP_HOST`` (advertised address override),
+``TPU_DIST_SHM`` / ``TPU_DIST_SHM_RING`` (shared-memory lanes, shm.py),
 ``TPU_DIST_DP_TIMEOUT`` (recv deadline, seconds, default 300),
 ``TPU_DIST_NO_DATAPLANE=1`` (disable; collectives fall back to the store),
 ``TPU_DIST_SOCK_BUF`` (bytes for ``SO_SNDBUF``/``SO_RCVBUF`` on every
@@ -44,6 +57,7 @@ call anyway, so there is no small-segment flood for Nagle to fix.
 
 from __future__ import annotations
 
+import json
 import os
 import socket
 import struct
@@ -59,6 +73,12 @@ __all__ = ["DataPlane", "PeerGoneError", "get_data_plane",
 
 _MAGIC = b"TPDP"
 _HELLO = struct.Struct("<4sII")      # magic, rank, generation
+# in-band SHM control frame (lane announce) + the dtype-name marker that
+# says "payload bytes are in the announced lane, not on this socket".
+# User tags are store-key-shaped paths, so the NUL prefix cannot collide.
+_SHM_TAG = "\x00shm-lane"
+_SHM_MARK = "&"
+_CONTROL = object()   # _read_frame sentinel: handled frame, nothing to queue
 _U8 = struct.Struct("<B")
 _U16 = struct.Struct("<H")
 _U32 = struct.Struct("<I")
@@ -216,6 +236,22 @@ class DataPlane:
         self._out_locks: Dict[int, threading.Lock] = {}
         self._out_mu = threading.Lock()
 
+        # shared-memory payload lanes (tpu_dist/collectives/shm.py):
+        # outbound per co-located destination (we create + own), inbound
+        # per announcing CONNECTION (keyed id(conn) — a reconnecting
+        # sender announces a fresh lane while the old connection's reader
+        # may still be draining the old one).  _shm_tried remembers a
+        # definitively failed/declined setup so sends stop re-probing.
+        self._shm_out: Dict[int, object] = {}
+        self._shm_in: Dict[int, object] = {}
+        self._shm_tried: Dict[int, bool] = {}
+        self._peer_host: Dict[int, bool] = {}  # dst -> co-located?
+
+        from .topology import host_fingerprint, publish_host_fingerprint
+        self.host_id = host_fingerprint(self.rank)
+        # host key BEFORE the addr key: peers wait on addr, so by the time
+        # an address is visible the fingerprint is too (no second wait)
+        publish_host_fingerprint(store, self.rank, self.generation)
         self.addr = f"{self._advertised_host()}:{self.port}"
         store.set(self._addr_key(self.rank), self.addr.encode())
 
@@ -223,11 +259,24 @@ class DataPlane:
             target=self._accept_loop, daemon=True,
             name=f"tpu_dist-dp-accept-r{rank}")
         self._accept_thread.start()
+        # interpreter-exit close (idempotent; close() unregisters it so a
+        # superseded incarnation's DataPlane is not pinned for process
+        # lifetime): drops lane mappings and sockets even when the process
+        # never reaches rendezvous.shutdown.  The exit-time variant must
+        # NOT touch the store: a client round-trip (native libtpudist)
+        # during interpreter teardown segfaults, and the addr key is
+        # generation-scoped debris the reaper covers.
+        import atexit
+        atexit.register(self.close, _at_exit=True)
 
     # -- addressing ----------------------------------------------------------
 
     def _addr_key(self, rank: int) -> str:
         return f"tpu_dist/g{self.generation}/dp/addr/{rank}"
+
+    def _host_key(self, rank: int) -> str:
+        from .topology import host_key
+        return host_key(self.generation, rank)
 
     def _advertised_host(self) -> str:
         host = os.environ.get("TPU_DIST_DP_HOST")
@@ -285,9 +334,11 @@ class DataPlane:
                 self._in_conn[peer] = conn
             self._obs("peer-connect", peer, sndbuf=bufs[0], rcvbuf=bufs[1])
             while True:
-                frame = self._read_frame(conn)
+                frame = self._read_frame(conn, peer)
                 if frame is None:
                     break
+                if frame is _CONTROL:
+                    continue  # lane announce — handled inside _read_frame
                 tag, arr = frame
                 with self._cv:
                     self._in_q.setdefault((peer, tag), deque()).append(arr)
@@ -299,6 +350,9 @@ class DataPlane:
                 conn.close()
             except OSError:
                 pass
+            lane = self._shm_in.pop(id(conn), None)
+            if lane is not None:
+                lane.close()  # this reader owned the mapping
             if peer is not None and not self._closing:
                 died = False
                 with self._cv:
@@ -311,10 +365,17 @@ class DataPlane:
                         self._cv.notify_all()
                         died = True
                 if died:
+                    # a dead peer will never attach our announced lane:
+                    # reap the name now (no-op if it already attached) so
+                    # a crashed pair leaves no /dev/shm debris.  The lane
+                    # object stays; a failed send replaces it.
+                    lane = self._shm_out.get(peer)
+                    if lane is not None:
+                        lane.unlink()
                     self._obs("peer-gone", peer, detail=detail,
                               outcome="error:PeerGone")
 
-    def _read_frame(self, conn):
+    def _read_frame(self, conn, peer):
         raw = _recv_exact(conn, _U32.size)
         if raw is None:
             return None
@@ -327,7 +388,17 @@ class DataPlane:
             _U64.unpack(bytes(_recv_exact_or_raise(conn, _U64.size)))[0]
             for _ in range(ndim))
         (plen,) = _U64.unpack(bytes(_recv_exact_or_raise(conn, _U64.size)))
-        payload = (_recv_exact_or_raise(conn, plen) if plen else bytearray())
+        if dtype_name.startswith(_SHM_MARK):
+            # payload bytes live in the announced SHM lane, not on the
+            # socket — drain them there (same framing contract otherwise)
+            dtype_name = dtype_name[len(_SHM_MARK):]
+            payload = self._lane_read(conn, peer, plen)
+        else:
+            payload = (_recv_exact_or_raise(conn, plen) if plen
+                       else bytearray())
+        if tag == _SHM_TAG:
+            self._attach_lane(conn, peer, payload)
+            return _CONTROL
         if dtype_name.startswith("q8b"):
             return tag, _decode_quant(dtype_name, shape, payload, plen)
         dtype = _decode_dtype(dtype_name)
@@ -339,6 +410,147 @@ class DataPlane:
                 f"frame payload {plen}B does not match shape {shape} "
                 f"dtype {dtype}")
         return tag, arr.reshape(shape)
+
+    # -- shared-memory lanes (tpu_dist/collectives/shm.py) -------------------
+
+    @staticmethod
+    def _peek_dead(sock) -> Optional[str]:
+        """Non-blocking liveness probe of a peer socket while parked in a
+        lane wait: EOF/reset means the peer died mid-frame (pending data —
+        e.g. the next frame header — means it is alive and streaming)."""
+        try:
+            b = sock.recv(1, socket.MSG_PEEK | socket.MSG_DONTWAIT)
+            if b == b"":
+                return "peer closed the connection mid-shm-frame"
+        except (BlockingIOError, InterruptedError):
+            return None
+        except OSError as e:
+            return f"connection error mid-shm-frame: {e!r}"
+        return None
+
+    def _lane_abort(self, sock):
+        def check() -> Optional[str]:
+            if self._closing:
+                return "data plane closed"
+            return self._peek_dead(sock)
+        return check
+
+    def _lane_read(self, conn, peer, plen: int) -> bytearray:
+        # lanes are keyed by CONNECTION, not peer: after a sender
+        # reconnect, the old connection's reader may still be draining
+        # frames that reference the old lane while the new connection has
+        # already announced a fresh one — each reader must keep consuming
+        # exactly the lane its own stream announced
+        lane = self._shm_in.get(id(conn))
+        if lane is None:
+            raise ConnectionError(
+                f"rank {peer} sent an shm-lane frame but never announced "
+                f"a lane on this connection")
+        buf = bytearray(plen)
+        if plen:
+            lane.read_into(buf, timeout=_default_timeout(),
+                           abort_check=self._lane_abort(conn))
+        return buf
+
+    def _attach_lane(self, conn, peer, payload) -> None:
+        info = json.loads(bytes(payload).decode())
+        from .shm import ShmLane
+        old = self._shm_in.pop(id(conn), None)
+        if old is not None:
+            old.close()  # re-announce on the SAME connection (shouldn't
+            # happen, but must not leak the mapping)
+        try:
+            self._shm_in[id(conn)] = ShmLane(name=info["name"],
+                                             capacity=info.get("capacity",
+                                                               0))
+        except Exception as e:
+            # the sender will stream payloads we cannot reach — this
+            # connection is unusable; fail it loudly (fingerprints lying
+            # about co-location is the only way here)
+            raise ConnectionError(
+                f"failed to attach shm lane {info.get('name')!r} announced "
+                f"by rank {peer} (host fingerprints claim co-location but "
+                f"the segment is unreachable): {e!r}") from e
+        self._obs("shm-lane", peer, name=info["name"], role="attached")
+
+    def _maybe_lane(self, dst: int, sock):
+        """The outbound SHM lane to ``dst``, set up on first use when the
+        peer is co-located and SHM is enabled; None otherwise (inline TCP
+        payloads).  Called under the destination's send lock.  Setup
+        failure falls back to TCP silently — only this rank's sends are
+        affected, so one-sided degradation cannot wedge a ring."""
+        from . import shm as _shm
+        if not _shm.shm_enabled():
+            return None
+        lane = self._shm_out.get(dst)
+        if lane is not None:
+            return lane
+        if self._shm_tried.get(dst):
+            return None
+        if not self.colocated(dst):
+            # stop probing only on a DEFINITIVE different-host answer; an
+            # unpublished fingerprint / transient store error stays
+            # uncached so a later send re-resolves (colocated()'s contract)
+            if dst in self._peer_host:
+                self._shm_tried[dst] = True
+            return None
+        try:
+            lane = _shm.ShmLane(create=True, generation=self.generation)
+        except Exception:
+            self._shm_tried[dst] = True  # no /dev/shm etc. — TCP fallback
+            return None
+        info = json.dumps({"name": lane.name,
+                           "capacity": lane.capacity}).encode()
+        header = _encode_frame_header(_SHM_TAG.encode(), b"uint8",
+                                      (len(info),), len(info))
+        try:
+            _sendv(sock, header, info)
+        except OSError:
+            lane.unlink()  # the announce never left: nobody will attach
+            lane.close()
+            raise  # connection trouble: the caller's send error path owns it
+        self._shm_out[dst] = lane
+        self._obs("shm-lane", dst, name=lane.name, role="owner",
+                  capacity=lane.capacity)
+        return lane
+
+    def shm_active(self, dst: int) -> bool:
+        """True when an outbound shared-memory lane to ``dst`` is up
+        (introspection for tests/benchmarks)."""
+        return dst in self._shm_out
+
+    def colocated(self, dst: int) -> bool:
+        """Whether ``dst`` shares this rank's host fingerprint (cached;
+        False until the peer has published — callers treat that as 'not
+        yet known', and the send path re-resolves at lane setup)."""
+        got = self._peer_host.get(dst)
+        if got is None:
+            from .topology import parse_host_record
+            try:
+                key = self._host_key(dst)
+                if not self._store.check(key):
+                    return False  # unpublished: do NOT cache the miss
+                peer_host, _ = parse_host_record(self._store.get(key))
+                got = peer_host == self.host_id
+            except Exception:
+                return False
+            self._peer_host[dst] = got
+        return got
+
+    def send_chunk_bytes(self, dst: int, base: int) -> int:
+        """Per-destination wire-frame grain for the ring
+        (tpu_dist/collectives/ring.py): shared-memory destinations take
+        far coarser frames (``TPU_DIST_SHM_CHUNK``, default 4 MiB) — the
+        transfer is a memcpy, so fine-grained pipelining only multiplies
+        per-frame overhead; TCP destinations keep ``base``."""
+        from . import shm as _shm
+        if not _shm.shm_enabled() or not self.colocated(dst):
+            return base
+        try:
+            return max(base, int(os.environ.get("TPU_DIST_SHM_CHUNK",
+                                                str(4 << 20))))
+        except ValueError:
+            return max(base, 4 << 20)
 
     # -- outbound ------------------------------------------------------------
 
@@ -381,10 +593,8 @@ class DataPlane:
         try:
             payload = memoryview(arr).cast("B")
         except (TypeError, ValueError):
-            payload = arr.tobytes()  # exotic dtypes without buffer support
-        header = _encode_frame_header(
-            tag.encode(), arr.dtype.name.encode(), shape, len(payload))
-        return self._send_frame(dst, header, (payload,))
+            payload = memoryview(arr.tobytes())  # exotic buffer-less dtypes
+        return self._send_frame(dst, tag, arr.dtype.name, shape, (payload,))
 
     def send_quant(self, dst: int, tag: str, chunk) -> int:
         """Send one block-quantized frame (a
@@ -395,20 +605,20 @@ class DataPlane:
         ``wire_bytes``."""
         scales = np.ascontiguousarray(chunk.scales, np.float32)
         q = np.ascontiguousarray(chunk.q, np.int8)
-        plen = scales.nbytes + q.nbytes
-        header = _encode_frame_header(
-            tag.encode(), f"q8b{chunk.scheme.block}".encode(),
-            (q.size,), plen)
         return self._send_frame(
-            dst, header,
+            dst, tag, f"q8b{chunk.scheme.block}", (q.size,),
             (memoryview(scales).cast("B"), memoryview(q).cast("B")))
 
-    def _send_frame(self, dst: int, header: bytes, parts) -> int:
+    def _send_frame(self, dst: int, tag: str, dtype_name: str, shape,
+                    parts) -> int:
         """Shared outbound path for plain and quantized frames: one
-        connection per destination, vectored send, peer death diagnosed
+        connection per destination, vectored send (or an SHM-lane payload
+        with a TCP header, for co-located peers), peer death diagnosed
         outside the send lock."""
         if dst == self.rank:
             raise ValueError("data plane does not deliver to self")
+        parts = [memoryview(p).cast("B") for p in parts]
+        plen = sum(len(p) for p in parts)
         send_err = None
         with self._out_lock(dst):
             sock = self._out.get(dst)
@@ -416,12 +626,50 @@ class DataPlane:
                 if sock is None:
                     sock = self._connect(dst)
                     self._out[dst] = sock
-                _sendv(sock, header, *parts)
+                lane = self._maybe_lane(dst, sock) if plen else None
+                if lane is not None:
+                    header = _encode_frame_header(
+                        tag.encode(), (_SHM_MARK + dtype_name).encode(),
+                        shape, plen)
+                    # payload FIRST (whatever fits without blocking), then
+                    # the header: by the time the receiver's reader parses
+                    # the header, the bytes are already in the ring — no
+                    # park-and-poll on the consumer's critical path.  Only
+                    # a frame overrunning the ring streams the remainder
+                    # after the header (the receiver drains concurrently).
+                    rest = []
+                    for p in parts:
+                        if rest:
+                            rest.append(p)  # keep strict byte order
+                        elif len(p):
+                            done = lane.write_some(p)
+                            if done < len(p):
+                                rest.append(p[done:])
+                    _sendv(sock, header)
+                    if rest:
+                        timeout = _default_timeout()
+                        abort = self._lane_abort(sock)
+                        for p in rest:
+                            lane.write(p, timeout=timeout,
+                                       abort_check=abort)
+                else:
+                    header = _encode_frame_header(
+                        tag.encode(), dtype_name.encode(), shape, plen)
+                    _sendv(sock, header, *parts)
             except PeerGoneError as e:
                 send_err = e  # _connect diagnosed the peer; the obs-tail
                 # enrichment still happens below, outside the lock
-            except OSError as e:
+            except (OSError, TimeoutError) as e:
                 self._out.pop(dst, None)
+                stale = self._shm_out.pop(dst, None)
+                self._shm_tried.pop(dst, None)
+                if stale is not None:
+                    # a reconnect announces a fresh lane — the receiver's
+                    # read position in this one is unknowable.  Unlink too:
+                    # the peer either attached already (name is gone,
+                    # no-op) or is dead/never-attaching (reap the name)
+                    stale.unlink()
+                    stale.close()
                 try:
                     if sock is not None:
                         sock.close()
@@ -434,7 +682,7 @@ class DataPlane:
             detail = (send_err.detail if isinstance(send_err, PeerGoneError)
                       else repr(send_err))
             raise self.gone_error(dst, detail) from send_err
-        return sum(len(p) for p in parts)
+        return plen
 
     # -- receive -------------------------------------------------------------
 
@@ -563,14 +811,22 @@ class DataPlane:
 
     # -- lifecycle -----------------------------------------------------------
 
-    def close(self) -> None:
+    def close(self, _at_exit: bool = False) -> None:
         if self._closing:
             return
         self._closing = True
-        try:
-            self._store.delete_key(self._addr_key(self.rank))
-        except Exception:
-            pass  # store may already be down; the key is generation-scoped
+        if not _at_exit:
+            try:
+                self._store.delete_key(self._addr_key(self.rank))
+            except Exception:
+                pass  # store may be down; the key is generation-scoped
+            import atexit
+            try:
+                # an explicitly-closed (superseded-incarnation) DataPlane
+                # must not stay pinned by its exit hook
+                atexit.unregister(self.close)
+            except Exception:
+                pass
         try:
             self._listener.close()
         except OSError:
@@ -583,7 +839,21 @@ class DataPlane:
                 s.close()
             except OSError:
                 pass
+        for lane in (list(self._shm_out.values())
+                     + list(self._shm_in.values())):
+            # mappings only — deliberately NO unlink: the receiver removed
+            # the name at attach, and unlinking a not-yet-attached lane
+            # here would lose frames a clean exit must deliver (shm.py's
+            # lifecycle note).  A receiver SIGKILLed before ever attaching
+            # leaves one named segment behind — bounded crash debris.
+            lane.close()
+        self._shm_out.clear()
+        self._shm_in.clear()
         with self._cv:
+            # undelivered frames die with the incarnation; dropping them
+            # here keeps a closed DataPlane from pinning megabytes of
+            # queued ndarrays for the rest of the process
+            self._in_q.clear()
             self._cv.notify_all()
 
     def __repr__(self):

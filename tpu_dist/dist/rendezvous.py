@@ -193,6 +193,17 @@ def _preflight(store, num_processes: int, process_id: int,
 
     pf_timeout = _pf_timeout(timeout)
     store.set(f"tpu_dist/alive/{process_id}", str(os.getpid()))
+    # host fingerprint, published with the liveness check-in: topology
+    # detection (tpu_dist/collectives/topology.py — SHM lane pairing, the
+    # hierarchical ring, algorithm autoselection) reads every rank's key.
+    # The DataPlane re-publishes the same key at construction, so
+    # store-injected test rigs that skip rendezvous stay covered.
+    try:
+        from ..collectives.topology import publish_host_fingerprint
+        publish_host_fingerprint(store, process_id, generation())
+    except Exception as e:
+        warnings.warn(f"host-fingerprint publish failed ({e!r}); topology "
+                      f"autoselection will fall back to the flat ring")
     deadline = time.monotonic() + pf_timeout
     waiting = set(range(num_processes))
     delay = 0.01

@@ -37,7 +37,7 @@ from typing import Optional
 
 from . import recorder
 
-__all__ = ["collective_span", "current_span", "note_path",
+__all__ = ["collective_span", "current_span", "note_path", "note_algo",
            "annotate_transport", "heartbeat_tick", "post_tail", "fetch_tail",
            "render_tail", "install_from_env", "install_signal_handlers"]
 
@@ -89,7 +89,8 @@ class _Span:
 
 
 def collective_span(op: str, value=None, reduce_op=None, src=None, dst=None,
-                    peer=None, kind: str = "collective", path=None):
+                    peer=None, kind: str = "collective", path=None,
+                    group=None):
     """Span context for one collective (or p2p) call.  ``kind='collective'``
     consumes the cross-rank collective sequence counter (every rank of an
     SPMD program opens span #N together — the merge key); ``kind='p2p'``
@@ -113,6 +114,14 @@ def collective_span(op: str, value=None, reduce_op=None, src=None, dst=None,
         pass
     if not fields.get("site"):
         fields["site"] = recorder.call_site()
+    if group is not None and kind == "collective":
+        # sub-group collectives run on MEMBER ranks only: consuming the
+        # world's lockstep `coll` counter would permanently skew the
+        # cross-rank merge/diagnose key for every later flat collective
+        # (members at #N+1, non-members at #N).  Like p2p spans, they are
+        # rank-asymmetric from the world's perspective — attributed by
+        # the `group` field instead of the lockstep sequence.
+        kind = "group-collective"
     if kind == "collective":
         fields["coll"] = rec.next_coll()
     if reduce_op is not None:
@@ -125,11 +134,25 @@ def collective_span(op: str, value=None, reduce_op=None, src=None, dst=None,
         fields["peer"] = int(peer)
     if path is not None:
         fields["path"] = path
+    if group is not None:
+        fields["group"] = str(group)  # SubGroup id (scoped collectives)
     if value is not None:
         dg, nbytes = recorder.digest(value)
         fields["digest"] = dg
         fields["bytes"] = nbytes
     return _Span(rec, rec.begin(kind, op, **fields))
+
+
+def note_algo(algo: str) -> None:
+    """Stamp the enclosing span with the selected collective algorithm
+    (``flat`` | ``hier`` | ``store`` — tpu_dist/collectives/topology.py's
+    autoselector), so traces show WHICH ring shape a payload took."""
+    span = current_span()
+    if span is None:
+        return
+    rec = recorder.get_recorder()
+    if rec is not None:
+        rec.update_event(span, algo=algo)
 
 
 def note_path(path: str) -> None:
