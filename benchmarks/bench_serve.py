@@ -1,0 +1,280 @@
+"""Continuous-batching serving benchmark — QPS/latency sweep + the
+continuous-vs-static throughput comparison (ISSUE 12 acceptance).
+
+Two modes over the SAME engine, compiled programs, and mixed-length
+request workload (short+long prompts, short+long ``max_new_tokens``):
+
+- ``static``: run-to-completion batching — admit a batch of ``slots``
+  requests, decode until EVERY slot finishes, only then admit the next
+  batch.  The classic serving baseline: short requests finish early and
+  their slots idle until the batch's longest request completes.
+- ``continuous``: the :class:`tpu_dist.serve.SlotEngine` scheduler path —
+  freed slots are refilled *between decode iterations*, so the pool stays
+  occupied and aggregate tokens/sec tracks the hardware, not the longest
+  request (acceptance: >= 2x static on the mixed workload).
+
+The QPS sweep drives the continuous engine at sustained request rates
+(fractions of its measured capacity) and reports per-request p50/p99
+end-to-end latency, time-to-first-token, and batch-slot occupancy — the
+latency histograms are the shared streaming
+:class:`tpu_dist.utils.metrics.LatencyHistogram` (no sample storage).
+
+``--smoke`` is the tier-1 gate (tests/test_serve.py): a tiny config whose
+STREAMED tokens are cross-checked token-for-token against offline
+``model.generate()`` for every request — continuous batching must be a
+scheduling optimization, never a numerics change.
+
+Output: BENCH JSON rows on stdout; full runs also write BENCH_SERVE.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _build(tiny: bool):
+    import jax
+
+    from tpu_dist.models import TransformerLM
+
+    if tiny:
+        cfg = dict(vocab_size=251, dim=64, depth=2, num_heads=2,
+                   max_seq_len=160)
+    else:
+        # big enough that a decode step's device cost dominates the
+        # per-step host bookkeeping (real serving models are far heavier);
+        # small enough to measure in seconds on a CPU CI box
+        cfg = dict(vocab_size=1024, dim=128, depth=3, num_heads=4,
+                   max_seq_len=160)
+    model = TransformerLM(**cfg)
+    params = model.init(jax.random.key(0))
+    return model, params, cfg
+
+
+def _workload(n: int, seed: int = 0, smoke: bool = False):
+    """Mixed-length requests: short prompts dominate, ~30% of requests
+    want a LONG generation — the shape that starves run-to-completion
+    batching (a batch lives as long as its longest member)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    reqs = []
+    for i in range(n):
+        if smoke:
+            # two prompt lengths x two gen lengths: bounds the number of
+            # distinct generate() compilations the cross-check needs
+            plen = int(rng.choice([6, 20]))
+            gen = int(rng.choice([4, 24]))
+        else:
+            plen = int(rng.choice([6, 12, 24, 40]))
+            gen = 96 if rng.random() < 0.2 else int(rng.choice([4, 8]))
+        prompt = rng.integers(0, 251, size=plen)
+        reqs.append((prompt.astype(np.int32), gen))
+    return reqs
+
+
+def _offline_refs(model, params, reqs):
+    """Ground truth per request: offline greedy ``generate()``."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    refs = []
+    for prompt, gen in reqs:
+        out = model.generate(params, jnp.asarray(prompt)[None, :], gen)
+        refs.append(np.asarray(out)[0, len(prompt):].tolist())
+    return refs
+
+
+def _warmup(engine, max_len: int):
+    """Compile every program the measured window will hit (each engine
+    instance owns its own jit cache): one prefill per prompt bucket the
+    workload uses + the pool decode step.  The caller resets stats after."""
+    import numpy as np
+
+    from tpu_dist.serve import Request
+
+    for plen in (6, 20, 24, 40):
+        if plen + 2 > max_len:
+            continue
+        r = Request(np.zeros(plen, np.int32), 2)
+        engine.admit(r)
+        while not engine.idle():
+            engine.step()
+
+
+def _run_static(model, params, reqs, slots: int, max_len: int):
+    """Run-to-completion batching over the same engine primitives: the
+    admission barrier is the ONLY difference from the continuous path."""
+    from tpu_dist.serve import Request, SlotEngine
+
+    engine = SlotEngine(model, params, num_slots=slots, max_len=max_len)
+    _warmup(engine, max_len)
+    engine.reset_stats()
+    by_id = {}
+
+    def on_token(req, tok):
+        by_id.setdefault(req.id, []).append(tok)
+
+    order = []
+    t0 = time.perf_counter()
+    for i in range(0, len(reqs), slots):
+        batch = reqs[i:i + slots]
+        for prompt, gen in batch:
+            r = Request(prompt, gen, on_token=on_token)
+            order.append(r.id)
+            engine.admit(r)
+        while not engine.idle():      # run-to-completion barrier
+            engine.step()
+    outputs = [by_id[rid] for rid in order]
+    wall = time.perf_counter() - t0
+    return {"mode": "static", "wall_sec": round(wall, 3),
+            "generated_tokens": engine.generated_tokens,
+            "tokens_per_sec": round(engine.generated_tokens / wall, 1),
+            "occupancy": round(engine.occupancy(), 3),
+            "outputs": outputs}
+
+
+def _run_continuous(model, params, reqs, slots: int, max_len: int,
+                    qps: float = 0.0, batch_window: float = 0.002):
+    """The scheduler path; ``qps`` > 0 paces submissions (sustained-rate
+    sweep), 0 submits everything up front (offline throughput)."""
+    from tpu_dist.serve import Scheduler, SlotEngine
+
+    engine = SlotEngine(model, params, num_slots=slots, max_len=max_len)
+    _warmup(engine, max_len)
+    engine.reset_stats()
+    sched = Scheduler(engine, batch_window=batch_window)
+    handles = []
+    t0 = time.perf_counter()
+    try:
+        for i, (prompt, gen) in enumerate(reqs):
+            if qps > 0:
+                target = t0 + i / qps
+                now = time.perf_counter()
+                if target > now:
+                    time.sleep(target - now)
+            h = sched.submit(prompt, max_new_tokens=gen, timeout=60.0)
+            handles.append(h)
+        outputs = [h.wait_done(timeout=600.0) for h in handles]
+        wall = time.perf_counter() - t0
+        stats = engine.stats()
+    finally:
+        sched.close()
+    e2e, ttft = stats["e2e"], stats["ttft"]
+    return {"mode": "continuous", "qps_target": qps,
+            "wall_sec": round(wall, 3),
+            "generated_tokens": stats["generated_tokens"],
+            "tokens_per_sec": round(stats["generated_tokens"] / wall, 1),
+            "occupancy": stats["occupancy"],
+            "p50_latency_ms": round(e2e["p50"] * 1e3, 1),
+            "p99_latency_ms": round(e2e["p99"] * 1e3, 1),
+            "p50_ttft_ms": round(ttft["p50"] * 1e3, 1),
+            "p99_ttft_ms": round(ttft["p99"] * 1e3, 1),
+            "outputs": outputs}
+
+
+def run(smoke: bool = False, requests: int = 0, slots: int = 8,
+        write_json: bool = True) -> dict:
+    model, params, cfg = _build(tiny=smoke)
+    max_len = cfg["max_seq_len"]
+    n = requests or (12 if smoke else 96)
+    reqs = _workload(n, smoke=smoke)
+
+    static = _run_static(model, params, reqs, slots, max_len)
+    cont = _run_continuous(model, params, reqs, slots, max_len)
+    speedup = (cont["tokens_per_sec"] / static["tokens_per_sec"]
+               if static["tokens_per_sec"] else 0.0)
+
+    if smoke:
+        # tier-1 correctness gate: STREAMED tokens == offline generate(),
+        # token for token, for every request, in BOTH batching modes
+        refs = _offline_refs(model, params, reqs)
+        cont_out = cont["outputs"]
+        stat_out = static["outputs"]
+        for i, ref in enumerate(refs):
+            assert cont_out[i] == ref, (
+                f"continuous-batching request {i} diverged from offline "
+                f"generate(): {cont_out[i]} vs {ref}")
+            assert stat_out[i] == ref, (
+                f"static-batching request {i} diverged from offline "
+                f"generate(): {stat_out[i]} vs {ref}")
+
+    rows = []
+    for r in (static, cont):
+        r = {k: v for k, v in r.items() if k != "outputs"}
+        r["metric"] = "serve_batching_mode"
+        r["slots"] = slots
+        r["requests"] = n
+        rows.append(r)
+    rows.append({"metric": "serve_continuous_vs_static_speedup",
+                 "value": round(speedup, 2), "unit": "x aggregate "
+                 "tokens/sec on the mixed-length workload",
+                 "acceptance": ">= 2.0 (full run; smoke gates correctness "
+                 "only)", "smoke": smoke})
+
+    # sustained-QPS sweep (skipped in smoke: latency percentiles on a
+    # contended CI box are noise, and the smoke's job is correctness)
+    sweep = []
+    if not smoke:
+        cap_rps = max(n / cont["wall_sec"], 1e-6)
+        for frac in (0.25, 0.5, 0.8):
+            r = _run_continuous(model, params, _workload(n, seed=1),
+                                slots, max_len, qps=frac * cap_rps)
+            row = {k: v for k, v in r.items() if k != "outputs"}
+            row["metric"] = "serve_qps_sweep"
+            row["qps_frac_of_capacity"] = frac
+            row["slots"] = slots
+            sweep.append(row)
+    rows.extend(sweep)
+
+    for r in rows:
+        print(json.dumps(r))
+
+    summary = {
+        "metric": "serve_continuous_batching_tokens_per_sec",
+        "value": cont["tokens_per_sec"],
+        "unit": f"aggregate generated tokens/sec ({slots} slots, "
+                f"mixed-length workload, dim {cfg['dim']} depth "
+                f"{cfg['depth']} LM)",
+        "static_tokens_per_sec": static["tokens_per_sec"],
+        "speedup_vs_static": round(speedup, 2),
+        "occupancy_continuous": cont["occupancy"],
+        "occupancy_static": static["occupancy"],
+        "qps_sweep": [{k: r[k] for k in ("qps_target", "p50_latency_ms",
+                                         "p99_latency_ms", "p50_ttft_ms",
+                                         "p99_ttft_ms", "occupancy")}
+                      for r in sweep],
+        "n_chips": 1,
+    }
+    if write_json and not smoke:
+        out = os.path.join(_REPO, "BENCH_SERVE.json")
+        with open(out, "w") as f:
+            json.dump(rows + [summary], f, indent=1)
+        print(f"wrote {out}")
+    return summary
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="tier-1 gate: tiny run, streamed-vs-offline "
+                         "token cross-check, no perf assertion")
+    ap.add_argument("--requests", type=int, default=0)
+    ap.add_argument("--slots", type=int, default=0)
+    args = ap.parse_args()
+    slots = args.slots or (4 if args.smoke else 8)
+    run(smoke=args.smoke, requests=args.requests, slots=slots)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

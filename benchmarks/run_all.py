@@ -128,7 +128,7 @@ def _better(new: dict, old: dict) -> dict:
 
 def main() -> None:
     sys.path.insert(0, _REPO)
-    from benchmarks import (attention, generate, imagenet_e2e,
+    from benchmarks import (attention, bench_serve, generate, imagenet_e2e,
                             input_pipeline, moe_lm, resnet_cifar, scaling,
                             transformer_lm, vit_train)
 
@@ -159,6 +159,7 @@ def main() -> None:
         "gen_latency": "transformer_lm_decode_batch1_tokens_per_sec",
         "gen_latency_int8": "transformer_lm_decode_batch1_int8_tokens_per_sec",
         "gen_long_int8_cache": "transformer_lm_decode_long_context_int8_cache",
+        "serve": "serve_continuous_batching_tokens_per_sec",
     }
     import bench  # repo-root headline (MNIST ConvNet) — ratchet a copy here
     results = []
@@ -179,7 +180,8 @@ def main() -> None:
                      ("gen_latency", generate.run_latency),
                      ("gen_latency_int8", generate.run_latency_int8),
                      ("gen_long_int8_cache",
-                      generate.run_long_context_int8_cache)):
+                      generate.run_long_context_int8_cache),
+                     ("serve", bench_serve.run)):
         try:
             r = fn()
         except Exception as e:  # record the failure, keep the rest running

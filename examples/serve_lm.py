@@ -1,0 +1,176 @@
+"""Serving worker — the model-rank half of ``python -m tpu_dist.launch
+--serve`` (ROADMAP item 4; docs/serving.md).
+
+Builds a :class:`~tpu_dist.models.TransformerLM`, wraps it in the
+continuous-batching :class:`~tpu_dist.serve.SlotEngine` +
+:class:`~tpu_dist.serve.Scheduler`, and listens with a
+:class:`~tpu_dist.serve.Frontend` whose address is published to the
+control-plane store (``tpu_dist/serve/backend``) so the launcher-spawned
+gateway finds it — including ACROSS supervised restarts, which is what
+makes the chaos story work: SIGKILL this process under load, the
+supervisor relaunches it, the fresh address lands on the same key, and
+the gateway's next submit reaches the new incarnation::
+
+    python -m tpu_dist.launch --standalone --max_restarts=3 --serve \\
+        examples/serve_lm.py --tiny
+
+Self-healing wiring: the worker publishes heartbeats
+(:class:`tpu_dist.resilience.Heartbeat`) with the scheduler's decode-step
+count as progress, so ``--heartbeat_timeout`` converts a wedged decode
+loop into a named ``RankLostError`` + supervised restart.
+
+``--exit-on-preempt`` is the serving half of the preemption protocol
+(cf. examples/elastic_train.py): on SIGTERM the worker STOPS ADMITTING,
+finishes every in-flight decode (queued-but-unadmitted requests fail
+with a named ``SchedulerDrainingError``), then exits
+``PREEMPTED_EXIT_CODE`` (117) so an elastic supervisor re-forms without
+it instead of burning restarts.
+
+Role split: rank 0 serves; other ranks (if any) idle with a heartbeat —
+the stepping stone to ROADMAP item 5's role-based process graphs, where
+model shards will run the engine cooperatively.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--backend", default="cpu",
+                   help="jax platform for the model (cpu|tpu)")
+    p.add_argument("--dim", type=int, default=256)
+    p.add_argument("--depth", type=int, default=4)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--vocab", type=int, default=32768)
+    p.add_argument("--max-seq-len", type=int, default=1024)
+    p.add_argument("--slots", type=int, default=8,
+                   help="KV-cache slots = max concurrent decodes")
+    p.add_argument("--cache-dtype", default="float32",
+                   choices=["float32", "bfloat16", "int8"])
+    p.add_argument("--port", type=int, default=0,
+                   help="frontend port (0 = ephemeral; the address is "
+                        "published to the store either way)")
+    p.add_argument("--batch-window", type=float, default=0.004,
+                   help="admission coalescing deadline, seconds")
+    p.add_argument("--tiny", action="store_true",
+                   help="toy model preset for tests/CI (fast compile)")
+    p.add_argument("--exit-on-preempt", action="store_true",
+                   help="on SIGTERM: drain (finish in-flight, admit "
+                        "nothing new) and exit PREEMPTED_EXIT_CODE (117)")
+    p.add_argument("--run-seconds", type=float, default=0.0,
+                   help="exit cleanly after N seconds (0 = run until "
+                        "signalled; tests use this as a safety bound)")
+    p.add_argument("--pid-file", default=None,
+                   help="write this process's pid here once serving "
+                        "(chaos tests SIGKILL through it)")
+    return p
+
+
+def main() -> int:
+    args = build_parser().parse_args()
+    os.environ.setdefault("JAX_PLATFORMS", args.backend)
+
+    import jax
+    import jax.numpy as jnp
+
+    import tpu_dist.dist as dist
+    from tpu_dist import resilience, serve
+    from tpu_dist import checkpoint as ckpt
+    from tpu_dist.models import TransformerLM
+
+    if args.tiny:
+        args.dim, args.depth, args.heads = 64, 2, 2
+        args.vocab, args.max_seq_len = 503, 192
+
+    # world 1 (the common serving shape today) skips the process group —
+    # rendezvous adds nothing over the store the frontend already uses
+    has_dist = (int(os.environ.get("WORLD_SIZE", "1") or 1) > 1
+                and "MASTER_ADDR" in os.environ)
+    if has_dist:
+        dist.init_process_group(backend=args.backend, init_method="env://")
+        rank = dist.get_rank()
+    else:
+        rank = 0
+        # no process group at world 1 — install the flight-recorder
+        # crash/exit dump handlers ourselves (rendezvous normally does
+        # this), so an armed serving rank still dumps its serve spans
+        from tpu_dist.obs.hooks import install_from_env
+        install_from_env()
+    store = serve.store_from_env()
+
+    # deterministic params (seed 0): a restarted incarnation serves the
+    # same model, so resubmitted greedy requests reproduce their tokens
+    model = TransformerLM(vocab_size=args.vocab, dim=args.dim,
+                          depth=args.depth, num_heads=args.heads,
+                          max_seq_len=args.max_seq_len)
+    params = model.init(jax.random.key(0))
+    cache_dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+                   "int8": jnp.int8}[args.cache_dtype]
+
+    hb = resilience.Heartbeat()
+    hb.start()
+    stop = ckpt.GracefulShutdown().__enter__() if args.exit_on_preempt \
+        else None   # entered for the process lifetime
+
+    if rank != 0:
+        # non-serving model rank: placeholder for the role-graph split
+        # (ROADMAP item 5) — stay alive, beat, obey the same signals
+        deadline = (time.monotonic() + args.run_seconds
+                    if args.run_seconds > 0 else None)
+        while deadline is None or time.monotonic() < deadline:
+            if stop is not None and stop.requested:
+                os._exit(resilience.PREEMPTED_EXIT_CODE)
+            time.sleep(0.25)
+        hb.stop()
+        if has_dist:
+            dist.destroy_process_group()
+        return 0
+
+    engine = serve.SlotEngine(model, params, num_slots=args.slots,
+                              max_len=args.max_seq_len,
+                              cache_dtype=cache_dtype)
+    sched = serve.Scheduler(engine, batch_window=args.batch_window,
+                            step_hook=hb.set_step)
+    frontend = serve.Frontend(sched, port=args.port, store=store)
+    print(f"[serve_lm] rank {rank} serving on {frontend.addr} "
+          f"({args.slots} slots, max_seq_len {args.max_seq_len})",
+          flush=True)
+    if args.pid_file:
+        with open(args.pid_file, "w") as f:
+            f.write(str(os.getpid()))
+
+    deadline = (time.monotonic() + args.run_seconds
+                if args.run_seconds > 0 else None)
+    try:
+        while deadline is None or time.monotonic() < deadline:
+            if stop is not None and stop.requested:
+                # preemption: stop admitting, finish in-flight decodes,
+                # then the elastic-shrink exit code.  os._exit like
+                # elastic_train.py: the jax coordination service's atexit
+                # teardown would block on peers mid-teardown.
+                drained = sched.drain(timeout=60.0)
+                print(f"[serve_lm] preempted: drained={drained}; exiting "
+                      f"{resilience.PREEMPTED_EXIT_CODE}", flush=True)
+                hb.stop()
+                os._exit(resilience.PREEMPTED_EXIT_CODE)
+            time.sleep(0.25)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        frontend.close()
+        sched.close()
+        hb.stop()
+        if has_dist:
+            dist.destroy_process_group()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

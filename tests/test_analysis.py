@@ -192,6 +192,46 @@ def collect(stats_zero, nonzero_counts):
     nonzero_counts.update(x=2)
 """
 
+# serving-era issuers (ISSUE 12): the ordered collective engine's submit
+# and the serve layer's Scheduler/ServeClient submit all return handles
+# whose captured errors surface only at wait/wait_done
+TD007_SERVE_POS = """
+def handle_request(sched, engine, prompt, body):
+    sched.submit(prompt, max_new_tokens=8)
+    engine.submit(body, label="x")
+"""
+
+TD007_SERVE_NEG = """
+def handle_request(sched, serve_client, pool, client_pool, prompt, fn):
+    h = sched.submit(prompt, max_new_tokens=8)
+    g = serve_client.submit(prompt)
+    pool.submit(fn)            # ThreadPoolExecutor: not an async issuer
+    client_pool.submit(fn)     # executor-named even with 'client' in it
+    return h.wait_done(30.0), g.wait_done(30.0)
+"""
+
+# serve blocking waits: wait_done/drain take their deadline positionally
+TD004_SERVE_POS = """
+def consume(handle, sched):
+    toks = handle.wait_done()
+    sched.drain()
+    return toks
+"""
+
+TD004_SERVE_NEG = """
+def consume(handle, sched):
+    toks = handle.wait_done(30.0)
+    sched.drain(timeout=60.0)
+    return toks
+"""
+
+# serving service-discovery keys are documented cross-generation infra
+TD003_SERVE_NEG = """
+def publish(store, addr):
+    store.set("tpu_dist/serve/backend", addr)
+    store.set("tpu_dist/serve/gateway", addr)
+"""
+
 # rank-divergent member list: every rank builds a DIFFERENT group, whose
 # ids/store scopes/wire tags can never match across ranks
 TD008_POS = """
@@ -338,6 +378,30 @@ class TestRules:
         # only zopt/zero_opt/zerooptimizer receivers count for .update —
         # a dict named stats_zero is not an async issuer
         assert _rules(lint_source(TD007_DICT_UPDATE_NEG, "t.py")) == []
+
+    def test_td007_serve_submit_issuers_flag_bare_drops(self):
+        # Scheduler.submit / ordered-engine submit return handles whose
+        # errors (QueueFullError, BackendGoneError, PeerGoneError) are
+        # lost if the handle is dropped on the spot
+        found = lint_source(TD007_SERVE_POS, "t.py")
+        assert _rules(found) == ["TD007", "TD007"]
+        assert all(f.severity == "error" for f in found)
+
+    def test_td007_serve_held_handles_and_executor_pass(self):
+        # held serve handles are fine, and ThreadPoolExecutor's ubiquitous
+        # .submit must never lint as an async collective
+        assert _rules(lint_source(TD007_SERVE_NEG, "t.py")) == []
+
+    def test_td004_serve_waits_need_deadlines(self):
+        found = lint_source(TD004_SERVE_POS, "t.py")
+        assert _rules(found) == ["TD004", "TD004"]
+        assert "wait_done" in found[0].message
+        assert _rules(lint_source(TD004_SERVE_NEG, "t.py")) == []
+
+    def test_td003_serve_discovery_keys_allowlisted(self):
+        # tpu_dist/serve/{backend,gateway} are cross-generation service
+        # discovery BY DESIGN (the gateway re-resolves across restarts)
+        assert _rules(lint_source(TD003_SERVE_NEG, "t.py")) == []
 
     def test_syntax_error_is_td000(self):
         (f,) = lint_source("def broken(:\n", "bad.py")

@@ -1,0 +1,470 @@
+"""tpu_dist.serve — slot engine parity, scheduler semantics, socket layer,
+obs spans, and the bench_serve smoke gate (ISSUE 12).
+
+The load-bearing assertion family: continuous batching is a SCHEDULING
+optimization — every token a slot emits must be identical to what offline
+``generate()`` emits for that request, whatever else the pool is doing.
+"""
+
+import json
+import os
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tpu_dist import serve
+from tpu_dist.models import TransformerLM
+
+pytestmark = pytest.mark.serve
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = TransformerLM(vocab_size=97, dim=32, depth=2, num_heads=4,
+                          max_seq_len=64)
+    params = model.init(jax.random.key(0))
+    return model, params
+
+
+def _gen_ref(model, params, prompt, n, **kw):
+    """Offline per-request ground truth (continuation only)."""
+    out = model.generate(params, jnp.asarray(prompt)[None, :], n, **kw)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+def _run_engine(model, params, reqs, slots=4, cache_dtype=None,
+                interleave=True):
+    """Drive the raw engine: admit mixed-length requests (interleaved with
+    decoding when ``interleave``) and return each request's tokens."""
+    engine = serve.SlotEngine(model, params, num_slots=slots,
+                              cache_dtype=cache_dtype)
+    outs = {}
+    order = []
+
+    def on_token(req, tok):
+        outs.setdefault(req.id, []).append(tok)
+
+    pending = [serve.Request(p, n, on_token=on_token) for p, n in reqs]
+    for r in pending:
+        order.append(r.id)
+    while pending or not engine.idle():
+        # admissions happen BETWEEN decode iterations, one per boundary
+        # when interleaving (maximally mixes prefills with decode states)
+        while pending and engine.free_slots() > 0:
+            engine.admit(pending.pop(0))
+            if interleave:
+                break
+        engine.step()
+    return [outs[rid] for rid in order], engine
+
+
+class TestSlotParity:
+    def test_batched_generate_equals_batch1(self, lm):
+        # ISSUE satellite: generate() at batch B is token-identical to B
+        # independent batch-1 decodes — the row-independence the slot
+        # math depends on
+        model, params = lm
+        rng = np.random.default_rng(0)
+        prompt = rng.integers(0, 97, (4, 7))
+        batched = np.asarray(model.generate(params, jnp.asarray(prompt), 6))
+        for b in range(4):
+            single = np.asarray(
+                model.generate(params, jnp.asarray(prompt[b:b + 1]), 6))
+            np.testing.assert_array_equal(batched[b], single[0])
+
+    def test_engine_matches_generate_mixed_lengths(self, lm):
+        # THE continuous-batching correctness pin: requests of different
+        # prompt lengths and max_new_tokens, admitted into a pool that is
+        # already decoding, each reproduce their offline generate() tokens
+        model, params = lm
+        rng = np.random.default_rng(1)
+        reqs = [(rng.integers(0, 97, rng.integers(3, 14)).astype(np.int32),
+                 int(rng.integers(2, 9))) for _ in range(7)]
+        outs, engine = _run_engine(model, params, reqs, slots=3)
+        for (p, n), got in zip(reqs, outs):
+            assert got == _gen_ref(model, params, p, n)
+        assert engine.completed == len(reqs)
+        assert engine.stats()["e2e"]["count"] == len(reqs)
+
+    def test_padded_prefill_logits_bitwise(self, lm):
+        # bucket padding must not perturb the last real token's logits
+        # (causal mask: real positions never attend to the padding)
+        model, params = lm
+        rng = np.random.default_rng(2)
+        prompt = rng.integers(0, 97, 5).astype(np.int32)
+        cache = model.init_slot_cache(2, 64)
+        padded = np.zeros(16, np.int32)
+        padded[:5] = prompt
+        logits, _ = model.prefill_into_slot(params, padded, 5, 1, cache)
+        ref_cache = model.init_cache(1, 64)
+        ref_logits, _ = model.apply(params, jnp.asarray(prompt)[None, :],
+                                    state=ref_cache)
+        np.testing.assert_array_equal(np.asarray(logits),
+                                      np.asarray(ref_logits)[0, -1])
+
+    def test_engine_int8_cache_matches_generate(self, lm):
+        # the quantized-cache decode path has its own per-slot write logic
+        # (k_scale/v_scale rows) — same parity contract
+        model, params = lm
+        rng = np.random.default_rng(3)
+        reqs = [(rng.integers(0, 97, rng.integers(3, 10)).astype(np.int32),
+                 int(rng.integers(2, 7))) for _ in range(4)]
+        outs, _ = _run_engine(model, params, reqs, slots=2,
+                              cache_dtype=jnp.int8)
+        for (p, n), got in zip(reqs, outs):
+            assert got == _gen_ref(model, params, p, n,
+                                   cache_dtype=jnp.int8)
+
+    def test_temperature_sampling_deterministic(self, lm):
+        # sampling requests are reproducible per (seed, prompt) and stay
+        # in-vocabulary; two engines agree token-for-token
+        model, params = lm
+        prompt = np.arange(4, dtype=np.int32)
+        runs = []
+        for _ in range(2):
+            outs, _ = _run_engine(model, params, [(prompt, 6)], slots=2)
+            runs.append(outs[0])
+        assert runs[0] == runs[1]
+        engine = serve.SlotEngine(model, params, num_slots=2)
+        got = {}
+        r = serve.Request(prompt, 6, temperature=0.8, seed=7,
+                          on_token=lambda q, t: got.setdefault(
+                              q.id, []).append(t))
+        engine.admit(r)
+        while not engine.idle():
+            engine.step()
+        toks = got[r.id]
+        assert len(toks) == 6 and all(0 <= t < 97 for t in toks)
+        engine2 = serve.SlotEngine(model, params, num_slots=2)
+        got2 = {}
+        r2 = serve.Request(prompt, 6, temperature=0.8, seed=7,
+                           on_token=lambda q, t: got2.setdefault(
+                               q.id, []).append(t))
+        engine2.admit(r2)
+        while not engine2.idle():
+            engine2.step()
+        assert got2[r2.id] == toks
+
+    def test_eos_frees_slot(self, lm):
+        model, params = lm
+        prompt = np.arange(5, dtype=np.int32)
+        ref = _gen_ref(model, params, prompt, 6)
+        eos = ref[2]   # the third emitted token, declared EOS
+        engine = serve.SlotEngine(model, params, num_slots=2)
+        done = {}
+        toks = []
+        r = serve.Request(prompt, 6, eos_id=eos,
+                          on_token=lambda q, t: toks.append(t),
+                          on_done=lambda q, reason: done.setdefault(
+                              "reason", reason))
+        engine.admit(r)
+        while not engine.idle():
+            engine.step()
+        assert done["reason"] == "eos"
+        assert toks == ref[:3]          # EOS emitted, then the slot freed
+        assert engine.free_slots() == 2
+
+    def test_validate_rejects_oversized(self, lm):
+        model, params = lm
+        engine = serve.SlotEngine(model, params, num_slots=2)
+        with pytest.raises(ValueError, match="exceeds the slot capacity"):
+            engine.validate(60, 10)
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            engine.validate(4, 0)
+
+
+class TestScheduler:
+    def test_coalesced_admission_and_completion(self, lm):
+        model, params = lm
+        engine = serve.SlotEngine(model, params, num_slots=4)
+        sched = serve.Scheduler(engine, batch_window=0.05)
+        try:
+            prompt = np.arange(5, dtype=np.int32)
+            handles = [sched.submit(prompt, max_new_tokens=5)
+                       for _ in range(3)]
+            ref = _gen_ref(model, params, prompt, 5)
+            for h in handles:
+                assert h.wait_done(60.0) == ref
+            # the batching window coalesced the burst: (far) fewer decode
+            # steps than 3 sequential runs would take
+            assert engine.stats()["decode_steps"] <= 10
+        finally:
+            sched.close()
+
+    def test_queue_full_is_named(self, lm):
+        model, params = lm
+        engine = serve.SlotEngine(model, params, num_slots=1)
+        sched = serve.Scheduler(engine, max_pending=1, stage_depth=1)
+        try:
+            prompt = np.arange(4, dtype=np.int32)
+            handles = [sched.submit(prompt, max_new_tokens=50, timeout=5.0)]
+            with pytest.raises(serve.QueueFullError):
+                for _ in range(16):
+                    handles.append(sched.submit(prompt, max_new_tokens=50,
+                                                timeout=0.05))
+            for h in handles:     # everything accepted still completes
+                h.wait_done(120.0)
+        finally:
+            sched.close()
+
+    def test_drain_finishes_inflight_rejects_queued(self, lm):
+        model, params = lm
+        engine = serve.SlotEngine(model, params, num_slots=1)
+        sched = serve.Scheduler(engine, batch_window=0.0)
+        try:
+            prompt = np.arange(4, dtype=np.int32)
+            inflight = sched.submit(prompt, max_new_tokens=40)
+            # in a slot before draining starts
+            deadline = time.monotonic() + 30
+            while not inflight.tokens() and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert inflight.tokens(), "request never started decoding"
+            queued = sched.submit(prompt, max_new_tokens=40)
+            assert sched.drain(timeout=60.0)
+            # in-flight finished with its full token budget
+            assert len(inflight.wait_done(5.0)) == 40
+            # queued-but-unadmitted failed with the NAMED drain error
+            with pytest.raises(serve.SchedulerDrainingError):
+                queued.wait_done(5.0)
+            # new submits are refused by name
+            with pytest.raises(serve.SchedulerDrainingError):
+                sched.submit(prompt, max_new_tokens=2)
+        finally:
+            sched.close()
+
+    def test_decode_loop_death_fails_everything_by_name(self, lm):
+        # review finding: an engine that dies mid-decode (device error,
+        # donated cache invalidated) must not leave a zombie scheduler —
+        # every in-flight AND queued handle fails naming the cause, and
+        # later submits are refused with the same diagnosis
+        model, params = lm
+        engine = serve.SlotEngine(model, params, num_slots=1)
+        sched = serve.Scheduler(engine)
+        try:
+            prompt = np.arange(4, dtype=np.int32)
+            inflight = sched.submit(prompt, max_new_tokens=40)
+            deadline = time.monotonic() + 30
+            while not inflight.tokens() and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert inflight.tokens(), "request never started decoding"
+            queued = sched.submit(prompt, max_new_tokens=40)
+
+            def boom():
+                raise RuntimeError("device died")
+
+            engine.step = boom
+            for h in (inflight, queued):
+                with pytest.raises(serve.SchedulerClosedError,
+                                   match="device died"):
+                    h.wait_done(30.0)
+            with pytest.raises(serve.SchedulerClosedError,
+                               match="device died"):
+                sched.submit(prompt, max_new_tokens=2)
+        finally:
+            sched.close()
+
+    def test_close_fails_pending_by_name(self, lm):
+        model, params = lm
+        engine = serve.SlotEngine(model, params, num_slots=1)
+        sched = serve.Scheduler(engine)
+        prompt = np.arange(4, dtype=np.int32)
+        handles = [sched.submit(prompt, max_new_tokens=30)
+                   for _ in range(4)]
+        sched.close()
+        outcomes = []
+        for h in handles:
+            try:
+                h.wait_done(10.0)
+                outcomes.append("done")
+            except serve.SchedulerClosedError:
+                outcomes.append("closed")
+        # every handle TERMINATED (none hung); the ones the shutdown cut
+        # off carry the named error
+        assert len(outcomes) == 4 and "closed" in outcomes
+
+
+class TestSocketLayer:
+    @pytest.fixture()
+    def stack(self, lm):
+        model, params = lm
+        engine = serve.SlotEngine(model, params, num_slots=4)
+        sched = serve.Scheduler(engine, batch_window=0.002)
+        fe = serve.Frontend(sched, port=0)
+        yield model, params, fe
+        fe.close()
+        sched.close()
+
+    def test_stream_roundtrip_interleaved(self, stack, lm):
+        model, params = lm
+        _, _, fe = stack
+        cli = serve.ServeClient("127.0.0.1", fe.port, connect_retry=10)
+        try:
+            rng = np.random.default_rng(5)
+            reqs = [(rng.integers(0, 97, rng.integers(3, 12)),
+                     int(rng.integers(2, 8))) for _ in range(6)]
+            handles = [cli.submit(p.tolist(), max_new_tokens=n)
+                       for p, n in reqs]
+            for h, (p, n) in zip(handles, reqs):
+                assert h.wait_done(120.0) == _gen_ref(model, params, p, n)
+                assert h.reason == "length"
+        finally:
+            cli.close()
+
+    def test_streaming_iterator(self, stack, lm):
+        model, params = lm
+        _, _, fe = stack
+        cli = serve.ServeClient("127.0.0.1", fe.port, connect_retry=10)
+        try:
+            prompt = np.arange(6, dtype=np.int32)
+            h = cli.submit(prompt.tolist(), max_new_tokens=5)
+            streamed = list(h.iter_tokens(timeout=60.0))
+            assert streamed == _gen_ref(model, params, prompt, 5)
+        finally:
+            cli.close()
+
+    def test_invalid_request_error_frame(self, stack):
+        _, _, fe = stack
+        cli = serve.ServeClient("127.0.0.1", fe.port, connect_retry=10)
+        try:
+            h = cli.submit(list(range(10)), max_new_tokens=500)
+            with pytest.raises(serve.RequestFailedError) as ei:
+                h.wait_done(30.0)
+            assert ei.value.error == "ValueError"
+        finally:
+            cli.close()
+
+    def test_gateway_proxies_and_names_backend_unavailable(self, stack,
+                                                           lm):
+        model, params = lm
+        _, _, fe = stack
+        gw = serve.Gateway(host="127.0.0.1", port=0, backend=fe.addr,
+                           backend_timeout=10.0)
+        cli = serve.ServeClient("127.0.0.1", gw.port, connect_retry=10)
+        try:
+            prompt = np.arange(5, dtype=np.int32)
+            got = cli.generate(prompt.tolist(), max_new_tokens=4,
+                               timeout=120.0)
+            assert got == _gen_ref(model, params, prompt, 4)
+        finally:
+            cli.close()
+            gw.close()
+        # a gateway whose backend address is dead fails submits with the
+        # NAMED availability error inside its bounded retry window
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+        s.close()
+        gw2 = serve.Gateway(host="127.0.0.1", port=0,
+                            backend=f"127.0.0.1:{dead_port}",
+                            backend_timeout=1.0)
+        cli2 = serve.ServeClient("127.0.0.1", gw2.port, connect_retry=10)
+        try:
+            h = cli2.submit([1, 2, 3], max_new_tokens=2)
+            with pytest.raises(serve.RequestFailedError) as ei:
+                h.wait_done(30.0)
+            assert ei.value.error == "BackendUnavailableError"
+        finally:
+            cli2.close()
+            gw2.close()
+
+    def test_client_fails_inflight_on_server_death(self):
+        # no-silent-drop from the client's side: a raw listener speaks the
+        # hello then dies mid-request — the in-flight handle must
+        # terminate with ServerGoneError, not hang
+        from tpu_dist.serve.frontend import _HELLO, _MAGIC, _VERSION
+
+        lst = socket.socket()
+        lst.bind(("127.0.0.1", 0))
+        lst.listen(1)
+        port = lst.getsockname()[1]
+
+        def server():
+            conn, _ = lst.accept()
+            conn.recv(_HELLO.size)
+            conn.sendall(_HELLO.pack(_MAGIC, _VERSION))
+            time.sleep(0.3)
+            conn.close()
+
+        t = threading.Thread(target=server, daemon=True)
+        t.start()
+        cli = serve.ServeClient("127.0.0.1", port, connect_retry=5)
+        h = cli.submit([1, 2, 3], max_new_tokens=4)
+        with pytest.raises(serve.ServerGoneError):
+            h.wait_done(30.0)
+        lst.close()
+        cli.close()
+
+
+class TestObsIntegration:
+    def test_request_span_fields_and_diagnose(self, lm, monkeypatch,
+                                              tmp_path):
+        from tpu_dist.obs import recorder as rec_mod
+        from tpu_dist.obs import trace as trace_mod
+
+        model, params = lm
+        monkeypatch.setenv("TPU_DIST_OBS", "1")
+        rec_mod.reset()
+        try:
+            engine = serve.SlotEngine(model, params, num_slots=2)
+            outs = []
+            r = serve.Request(np.arange(4, dtype=np.int32), 3,
+                              on_token=lambda q, t: outs.append(t))
+            serve.SlotEngine.obs_open(r)
+            engine.admit(r)
+            while not engine.idle():
+                engine.step()
+            # a second request left PENDING (queued, never admitted):
+            # the stuck-request shape the diagnosis must name
+            stuck = serve.Request(np.arange(5, dtype=np.int32), 4)
+            serve.SlotEngine.obs_open(stuck)
+
+            rec = rec_mod.get_recorder()
+            evs = [e for e in rec.snapshot() if e.get("kind") == "serve"]
+            assert len(evs) == 2
+            done = next(e for e in evs if e["outcome"] == "ok")
+            assert done["req"] == r.id and done["tokens"] == 3
+            assert done["queue_ns"] >= 0 and done["prefill_ns"] > 0
+            assert done["slot"] == 0
+
+            path = rec.dump("test", dir=str(tmp_path))
+            with open(path) as f:
+                dump = json.load(f)
+            diag = trace_mod.diagnose([dump])
+            assert diag["stuck_requests"], diag
+            sr = diag["stuck_requests"][0]
+            assert sr["req"] == stuck.id and sr["phase"] == "queued"
+            assert "stuck request" in trace_mod.render_diagnosis(diag)
+        finally:
+            rec_mod.reset()
+
+
+# bench_serve --smoke IS a tier-1 test (ISSUE 12 CI gate): cross-checks
+# the STREAMED continuous-batching tokens against offline generate()
+def test_bench_serve_smoke():
+    env = dict(os.environ,
+               PYTHONPATH=_REPO + os.pathsep + os.environ.get("PYTHONPATH",
+                                                              ""),
+               JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "benchmarks.bench_serve", "--smoke"],
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=420)
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    rows = [json.loads(line) for line in r.stdout.strip().splitlines()]
+    modes = {row.get("mode"): row for row in rows
+             if row.get("metric") == "serve_batching_mode"}
+    assert modes["continuous"]["tokens_per_sec"] > 0
+    assert modes["static"]["tokens_per_sec"] > 0
+    assert modes["continuous"]["occupancy"] >= modes["static"]["occupancy"]
+    assert any(row.get("metric") == "serve_continuous_vs_static_speedup"
+               for row in rows)

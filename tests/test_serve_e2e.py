@@ -1,0 +1,170 @@
+"""Serving chaos e2e — the ISSUE 12 acceptance runs.
+
+Real OS processes on the CPU backend (``serve`` + ``chaos`` markers,
+deliberately tier-1): the serving worker under the supervising launcher
+with the gateway role, a SIGKILL of the model rank under sustained load,
+and the preemption drain protocol.
+
+The no-silent-drop contract is asserted FROM THE CLIENT: every request in
+flight at the kill either completes or fails with a named error within a
+bounded wait — no handle hangs, nothing vanishes.
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from tpu_dist import serve
+from tpu_dist.models import TransformerLM
+
+pytestmark = [pytest.mark.serve, pytest.mark.chaos,
+              pytest.mark.multiprocess]
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("TPU_DIST_CHAOS", None)
+    return env
+
+
+def _tiny_ref(prompt, n):
+    """Offline ground truth for the serve_lm --tiny model (same seed-0
+    params every incarnation builds)."""
+    import jax.numpy as jnp
+
+    model = TransformerLM(vocab_size=503, dim=64, depth=2, num_heads=2,
+                          max_seq_len=192)
+    params = model.init(jax.random.key(0))
+    out = model.generate(params, jnp.asarray(prompt)[None, :], n)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+def test_exit_on_preempt_drains_then_117(tmp_path):
+    """SIGTERM mid-decode: the worker stops admitting, FINISHES the
+    in-flight request (full token budget), and exits 117 — the serving
+    half of the elastic preemption protocol."""
+    port = _free_port()
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(_REPO, "examples", "serve_lm.py"),
+         "--tiny", "--port", str(port), "--exit-on-preempt",
+         "--run-seconds", "300"],
+        env=_env(), cwd=_REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    try:
+        cli = serve.ServeClient("127.0.0.1", port, connect_retry=120.0)
+        prompt = list(range(1, 9))
+        h = cli.submit(prompt, max_new_tokens=120)
+        # wait for the first streamed token so TERM lands mid-decode
+        first = next(iter(h.iter_tokens(timeout=120.0)))
+        proc.send_signal(signal.SIGTERM)
+        toks = h.wait_done(timeout=120.0)     # in-flight decode FINISHES
+        assert len(toks) == 120 and toks[0] == first
+        assert toks == _tiny_ref(prompt, 120)
+        rc = proc.wait(timeout=60)
+        assert rc == 117, rc
+        cli.close()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
+def test_model_rank_sigkill_named_errors_then_resume(tmp_path):
+    """THE chaos acceptance: launcher + gateway + worker; SIGKILL the
+    model rank under sustained load; every in-flight request terminates
+    (completed or NAMED error — asserted by the client, no silent drops);
+    after the supervised restart, new requests on the SAME client
+    connection succeed and reproduce the pre-kill tokens bit-for-bit."""
+    serve_port = _free_port()
+    pid_file = str(tmp_path / "worker.pid")
+    log = open(tmp_path / "launcher.log", "w")
+    launcher = subprocess.Popen(
+        [sys.executable, "-m", "tpu_dist.launch", "--standalone",
+         "--max_restarts", "2", "--serve", "--serve_port", str(serve_port),
+         os.path.join(_REPO, "examples", "serve_lm.py"),
+         "--tiny", "--pid-file", pid_file, "--run-seconds", "600"],
+        env=_env(), cwd=_REPO, stdout=log, stderr=log)
+    cli = None
+    try:
+        cli = serve.ServeClient("127.0.0.1", serve_port,
+                                connect_retry=120.0)
+        probe_prompt = list(range(3, 10))
+        # warm request proves the full path (client->gateway->worker) and
+        # records the reference tokens the restarted rank must reproduce
+        ref = cli.submit(probe_prompt, max_new_tokens=8).wait_done(240.0)
+        assert ref == _tiny_ref(probe_prompt, 8)
+
+        # sustained load: long decodes that will straddle the kill
+        inflight = [cli.submit(list(range(2, 2 + 6 + i)),
+                               max_new_tokens=150) for i in range(6)]
+        # let them reach the decode phase, then SIGKILL the model rank
+        next(iter(inflight[0].iter_tokens(timeout=120.0)))
+        with open(pid_file) as f:
+            worker_pid = int(f.read().strip())
+        os.kill(worker_pid, signal.SIGKILL)
+
+        outcomes = {"done": 0, "named": 0}
+        for h in inflight:
+            try:
+                h.wait_done(timeout=120.0)   # BOUNDED: no hangs allowed
+                outcomes["done"] += 1
+            except serve.RequestFailedError as e:
+                # the gateway named the failure: the model rank died
+                assert e.error in ("BackendGoneError",
+                                   "BackendUnavailableError"), e
+                outcomes["named"] += 1
+        # nothing silently dropped, and the kill really cut requests off
+        assert outcomes["done"] + outcomes["named"] == len(inflight)
+        assert outcomes["named"] >= 1, outcomes
+
+        # supervised restart: the SAME client connection serves new
+        # traffic once the relaunched rank republishes its address —
+        # bounded retries because restart + jax re-import takes a while
+        deadline = time.monotonic() + 300
+        got = None
+        while time.monotonic() < deadline:
+            try:
+                got = cli.submit(probe_prompt,
+                                 max_new_tokens=8).wait_done(120.0)
+                break
+            except serve.RequestFailedError:
+                time.sleep(1.0)   # backend still restarting: named, retry
+        assert got == ref, f"post-restart output diverged: {got} vs {ref}"
+    finally:
+        if cli is not None:
+            cli.close()
+        # SIGINT = the launcher's clean teardown path (kills its children)
+        if launcher.poll() is None:
+            launcher.send_signal(signal.SIGINT)
+            try:
+                launcher.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                launcher.kill()
+                launcher.wait()
+        log.close()
+        # belt-and-braces: no orphaned worker survives the test
+        try:
+            with open(pid_file) as f:
+                os.kill(int(f.read().strip()), signal.SIGKILL)
+        except (OSError, ValueError):
+            pass

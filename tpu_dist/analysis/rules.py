@@ -91,6 +91,8 @@ _WAIT_METHODS: Dict[str, int] = {
     "barrier": 2,          # store.barrier(world, tag, timeout)
     "monitored_barrier": 2,
     "recv_array": 2,       # dp.recv_array(src, tag, timeout)
+    "wait_done": 0,        # serve RequestHandle.wait_done(timeout)
+    "drain": 0,            # serve Scheduler.drain(timeout)
 }
 _TIMEOUT_KWARGS = frozenset({"timeout", "deadline", "timeout_s"})
 
@@ -103,6 +105,10 @@ TD003_ALLOWED_PREFIXES = (
     "tpu_dist/master_port", # coordinator port negotiation (pre-generation)
     "tpu_dist/elastic",     # launcher restart agreement (round-scoped keys)
     "tpu_dist/hb",          # heartbeats (generation-scoped by path segment)
+    "tpu_dist/serve",       # serving-role service discovery (backend/gateway
+                            # addresses): overwritten by each incarnation and
+                            # read ACROSS restarts by design — the gateway
+                            # re-resolves a restarted backend through it
     "tpu_dist/g",           # already in the generation namespace
 )
 
@@ -604,6 +610,19 @@ def _is_async_call(node: ast.AST) -> bool:
     # unambiguously name a ZeRO optimizer count, not any *zero* substring
     if name == "update" and ("zopt" in recv_name or "zeroopt" in recv_name
                              or "zero_opt" in recv_name):
+        return True
+    # handle-returning submits: the ordered collective engine
+    # (collectives/work.py Engine.submit -> Work) and the serving layer
+    # (Scheduler.submit / ServeClient.submit -> RequestHandle, whose
+    # captured errors — QueueFullError, BackendGoneError — surface at
+    # wait_done()).  ThreadPoolExecutor receivers (pool/executor) are
+    # deliberately NOT matched.
+    if name == "submit" and ("engine" in recv_name or "sched" in recv_name
+                             or "serve" in recv_name
+                             or "client" in recv_name) \
+            and "pool" not in recv_name and "executor" not in recv_name:
+        # the exclusion keeps the carve-out honest for names that hit both
+        # vocabularies (client_pool.submit is an executor, not an issuer)
         return True
     return False
 

@@ -136,6 +136,27 @@ def _tune_socket(sock) -> Tuple[int, int]:
         return (0, 0)
 
 
+def store_routed_host(store) -> str:
+    """The local interface that routes toward the control-plane store —
+    the address peers on OTHER hosts can reach this process on (a UDP
+    ``connect`` resolves the route without sending traffic).  Loopback
+    when the store is local/absent.  Shared by the data plane's address
+    advertisement and the serve gateway's discovery key — one probe, so
+    the two can never publish inconsistent interfaces."""
+    target = getattr(store, "host", None)
+    if not target or target in ("127.0.0.1", "localhost", "0.0.0.0", ""):
+        return "127.0.0.1"
+    try:
+        probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            probe.connect((target, int(getattr(store, "port", 1))))
+            return probe.getsockname()[0]
+        finally:
+            probe.close()
+    except OSError:
+        return "127.0.0.1"
+
+
 def _sendv(sock, header: bytes, *payloads) -> None:
     """Vectored send: header + every payload part leave in one ``sendmsg``
     syscall — no concat copy of the payloads, no separate header segment
@@ -282,20 +303,7 @@ class DataPlane:
         host = os.environ.get("TPU_DIST_DP_HOST")
         if host:
             return host
-        target = getattr(self._store, "host", None)
-        if not target or target in ("127.0.0.1", "localhost", "0.0.0.0", ""):
-            return "127.0.0.1"
-        # the address peers can reach us on is whatever interface routes
-        # toward the store server (UDP connect does no traffic)
-        try:
-            probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-            try:
-                probe.connect((target, int(getattr(self._store, "port", 1))))
-                return probe.getsockname()[0]
-            finally:
-                probe.close()
-        except OSError:
-            return "127.0.0.1"
+        return store_routed_host(self._store)
 
     # -- inbound -------------------------------------------------------------
 

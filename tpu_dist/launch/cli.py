@@ -138,6 +138,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "`python -m tpu_dist.obs` (docs/observability.md). "
                         "On a failed round the supervisor prints each "
                         "rank's last known position from the store")
+    p.add_argument("--serve", action="store_true",
+                   help="start the serving gateway role alongside the "
+                        "workers (tpu_dist.serve, docs/serving.md): a "
+                        "client-facing proxy on --serve_port that resolves "
+                        "the model rank's frontend through the store key "
+                        "tpu_dist/serve/backend and SURVIVES worker "
+                        "restarts — in-flight requests at a model-rank "
+                        "death fail with a named BackendGoneError and new "
+                        "requests reach the relaunched rank. Needs the "
+                        "control-plane store. Workers run a frontend, e.g. "
+                        "examples/serve_lm.py")
+    p.add_argument("--serve_port", type=int, default=0,
+                   help="gateway's client-facing port (0 = ephemeral; the "
+                        "bound address is published to the store under "
+                        "tpu_dist/serve/gateway)")
     p.add_argument("--standalone", action="store_true",
                    help="single-node mode with automatic rendezvous "
                         "(torchrun parity): forces --nnodes=1 "
@@ -817,6 +832,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "control-plane store; fix the store setup error "
                          "above or drop --max_restarts\n")
         return 2
+    # --serve: the gateway role is spawned ONCE, outside the restart loop
+    # — its whole point is surviving worker relaunches (it re-resolves the
+    # backend address from the store after each restart)
+    gateway_proc = None
+    if args.serve:
+        if store_addr is None:
+            sys.stderr.write("--serve needs the control-plane store "
+                             "(drop --no_store / fix the store error "
+                             "above)\n")
+            return 2
+        if args.node_rank == 0:
+            gw_env = dict(os.environ, TPU_DIST_STORE_ADDR=store_addr)
+            gateway_proc = subprocess.Popen(
+                [sys.executable, "-m", "tpu_dist.serve", "gateway",
+                 "--port", str(args.serve_port)], env=gw_env)
+
     restarts = 0   # failure budget, compared against --max_restarts
     rnd = 0        # generation: EVERY relaunch (failure OR elastic world
     #                change) advances it, so a re-formed gang can never
@@ -911,6 +942,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                 # env — no store re-publication needed
                 master_port = _free_port()
     finally:
+        if gateway_proc is not None and gateway_proc.poll() is None:
+            gateway_proc.terminate()
+            try:
+                gateway_proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                gateway_proc.kill()
+                # tpudlint: disable=TD004  # reaping a SIGKILLed child
+                gateway_proc.wait()
         if store is not None:
             try:
                 store.close()

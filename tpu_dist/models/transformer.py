@@ -129,7 +129,12 @@ class TransformerLM(nn.Module):
             else:
                 pos_offset = 0
         if self.pos is not None:
-            x = self.tok(idx) + self.pos(pos_offset + jnp.arange(t))
+            off = jnp.asarray(pos_offset)
+            # vector pos_offset = per-slot decode positions (decode_step):
+            # (B,) offsets index a (B, t) position table row per sequence
+            pos_idx = (off[..., None] + jnp.arange(t) if off.ndim
+                       else pos_offset + jnp.arange(t))
+            x = self.tok(idx) + self.pos(pos_idx)
         else:
             # rope: positions enter through the attention rotations
             x = self.tok(idx)
@@ -187,6 +192,76 @@ class TransformerLM(nn.Module):
         return {attn._path: attn.init_cache(batch, max_len, dtype)
                 for attn in (getattr(self, f"block{i}").attn
                              for i in range(self.depth))}
+
+    # -- slot-pool decode (continuous batching; tpu_dist.serve) ------------
+
+    def init_slot_cache(self, slots: int, max_len: Optional[int] = None,
+                        dtype=jnp.float32):
+        """KV-cache pool for slot-based continuous-batching decode: the
+        :meth:`init_cache` layout WITHOUT the per-layer scalar write index
+        — each call to :meth:`decode_step` supplies every slot's position
+        as the ``lengths`` vector instead, so the host-side engine
+        (:class:`tpu_dist.serve.SlotEngine`) holds the single source of
+        truth for slot occupancy."""
+        return {path: {k: v for k, v in entry.items() if k != "index"}
+                for path, entry in
+                self.init_cache(slots, max_len, dtype).items()}
+
+    def decode_step(self, params, tokens, lengths, cache):
+        """ONE decode iteration over a slot pool: feed each slot's current
+        last token, get each slot's next-token logits.
+
+        ``tokens``: (B,) int — the token each slot decoded last (or the
+        prompt's last token right after prefill).  ``lengths``: (B,) int —
+        tokens already resident in each slot's cache row, i.e. the write
+        position.  ``cache``: from :meth:`init_slot_cache` /
+        :meth:`prefill_into_slot`.  Returns ``(logits (B, vocab),
+        new_cache)``.  Free slots decode garbage rows the caller masks;
+        their cache writes land in rows the next prefill overwrites.
+        The math per row is exactly :meth:`generate`'s decode scan — the
+        scan *uses* this method — so slot decode and offline generation
+        cannot drift."""
+        lengths = jnp.asarray(lengths, jnp.int32)
+        state = {path: dict(entry, index=lengths)
+                 for path, entry in cache.items()}
+        tokens = jnp.asarray(tokens)[:, None]
+        logits, state = self.apply(params, tokens, pos_offset=lengths,
+                                   state=state)
+        new_cache = {path: {k: v for k, v in state[path].items()
+                            if k != "index"}
+                     for path in cache}
+        return logits[:, -1], new_cache
+
+    def prefill_into_slot(self, params, prompt, length, slot, cache):
+        """Prefill ONE request into slot ``slot`` of a slot-cache pool
+        while other slots' rows are untouched — the admission half of
+        continuous batching.
+
+        ``prompt``: (P,) int tokens, padded past ``length`` with any valid
+        token id (padding K/V lands at positions ``>= length``, which
+        every later decode step either masks out or overwrites before
+        attending).  ``length``: true token count (traced OK).  Returns
+        ``(last-real-token logits (vocab,), new_cache)`` — sample the
+        request's first generated token from those logits.  One padded
+        prompt length = one compiled program; bucket prompt lengths to
+        bound retraces."""
+        entry = next(iter(cache.values()))
+        max_len, dtype = entry["k"].shape[1], entry["k"].dtype
+        pre = self.init_cache(1, max_len, dtype)
+        logits, st = self.apply(params, jnp.asarray(prompt)[None, :],
+                                state=pre)
+        slot = jnp.asarray(slot, jnp.int32)
+        new_cache = {}
+        for path, pool in cache.items():
+            row = st[path]
+            new_cache[path] = {
+                k: jax.lax.dynamic_update_slice(
+                    pool[k], row[k].astype(pool[k].dtype),
+                    (slot,) + (0,) * (pool[k].ndim - 1))
+                for k in pool}
+        return jax.lax.dynamic_index_in_dim(
+            logits[0], jnp.asarray(length, jnp.int32) - 1, axis=0,
+            keepdims=False), new_cache
 
     def generate(self, params, prompt, max_new_tokens: int,
                  temperature: float = 0.0, rng=None, cache_dtype=None,
@@ -247,16 +322,21 @@ class TransformerLM(nn.Module):
         logits, cache = self.apply(params, prompt, state=cache)
         key0 = rng if rng is not None else jax.random.key(0)
         first = sample(logits[:, -1], jax.random.fold_in(key0, 0))
+        # the decode loop runs on the slot-pool primitive (decode_step):
+        # lengths = tp + i for every row, so offline generation and the
+        # serving engine's continuous-batching decode share ONE code path
+        slot_cache = {path: {k: v for k, v in entry.items() if k != "index"}
+                      for path, entry in cache.items()}
 
         def step(carry, i):
             tok, cache = carry
-            logits, cache = self.apply(params, tok[:, None],
-                                       pos_offset=tp + i, state=cache)
-            nxt = sample(logits[:, -1], jax.random.fold_in(key0, i + 1))
+            lengths = jnp.full((b,), tp, jnp.int32) + i
+            logits, cache = self.decode_step(params, tok, lengths, cache)
+            nxt = sample(logits, jax.random.fold_in(key0, i + 1))
             return (nxt, cache), tok
 
         (last, _), toks = jax.lax.scan(
-            step, (first, cache), jnp.arange(max_new_tokens - 1))
+            step, (first, slot_cache), jnp.arange(max_new_tokens - 1))
         # toks holds tokens emitted *before* each step; append the final one
         out = jnp.concatenate(
             [prompt, jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1)

@@ -111,11 +111,14 @@ def rotary_embed(x, positions, theta: float = 10000.0):
     d = x.shape[-1]
     half = d // 2
     freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) * 2.0 / d)
-    ang = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # (T, half)
-    # (T, 1, half) broadcasts against (..., T, H, half) for ANY number of
-    # leading batch dims (including none)
-    cos = jnp.cos(ang)[:, None, :]
-    sin = jnp.sin(ang)[:, None, :]
+    # positions may be (T,) — shared across the batch — or carry leading
+    # batch dims, e.g. (B, T) during per-slot continuous-batching decode
+    # where every cache slot sits at its own position
+    ang = positions.astype(jnp.float32)[..., None] * freqs  # (..., T, half)
+    # (..., T, 1, half) broadcasts against (..., T, H, half) for ANY number
+    # of leading batch dims (including none)
+    cos = jnp.cos(ang)[..., None, :]
+    sin = jnp.sin(ang)[..., None, :]
     x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(
         jnp.float32)
     out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
@@ -198,7 +201,10 @@ class MultiheadSelfAttention(Module):
                 offset = lax.axis_index(self.sequence_axis) * t
             else:
                 offset = 0
-            pos = offset + jnp.arange(t)
+            off = jnp.asarray(offset)
+            # vector offset = per-slot decode positions: (B,) -> (B, t)
+            pos = (off[..., None] + jnp.arange(t) if off.ndim
+                   else offset + jnp.arange(t))
             q = rotary_embed(q, pos, self.rope_theta)
             k = rotary_embed(k, pos, self.rope_theta)
         if ctx.state is not None and self._path in ctx.state:
@@ -248,32 +254,62 @@ class MultiheadSelfAttention(Module):
         Long-context decode reads the cache, not the weights; halving its
         bytes halves the bandwidth bill where it dominates."""
         st = ctx.get_state(self._path)
-        index = st["index"]
+        index = jnp.asarray(st["index"])
         t = q.shape[1]
         int8_cache = st["k"].dtype == jnp.int8
         if int8_cache:
             kq, ks = self._quantize_kv(k)
             vq, vs = self._quantize_kv(v)
-            st = dict(
-                st,
-                k=jax.lax.dynamic_update_slice(st["k"], kq, (0, index, 0, 0)),
-                v=jax.lax.dynamic_update_slice(st["v"], vq, (0, index, 0, 0)),
-                k_scale=jax.lax.dynamic_update_slice(
-                    st["k_scale"], ks, (0, index, 0)),
-                v_scale=jax.lax.dynamic_update_slice(
-                    st["v_scale"], vs, (0, index, 0)))
+        if index.ndim:
+            # per-slot write positions (continuous batching, serve/engine):
+            # index is (B,) — every cache slot appends at its OWN position
+            # and masks to its own prefix.  Rows whose slot is free write
+            # garbage the next prefill fully overwrites (and mask away).
+            b = q.shape[0]
+            rows = jnp.arange(b)[:, None]                     # (B, 1)
+            cols = index[:, None] + jnp.arange(t)[None, :]    # (B, t)
+            if int8_cache:
+                st = dict(st,
+                          k=st["k"].at[rows, cols].set(kq),
+                          v=st["v"].at[rows, cols].set(vq),
+                          k_scale=st["k_scale"].at[rows, cols].set(ks),
+                          v_scale=st["v_scale"].at[rows, cols].set(vs))
+            else:
+                st = dict(st,
+                          k=st["k"].at[rows, cols].set(
+                              k.astype(st["k"].dtype)),
+                          v=st["v"].at[rows, cols].set(
+                              v.astype(st["v"].dtype)))
+            ctx.put_state(self._path, dict(st, index=index + t))
+            tmax = st["k"].shape[1]
+            kpos = jnp.arange(tmax)
+            # (B, 1, t, Tmax): per-row causal+unwritten mask, broadcast
+            # over heads
+            mask = (kpos[None, None, :] <= cols[:, :, None])[:, None]
         else:
-            st = dict(
-                st,
-                k=jax.lax.dynamic_update_slice(
-                    st["k"], k.astype(st["k"].dtype), (0, index, 0, 0)),
-                v=jax.lax.dynamic_update_slice(
-                    st["v"], v.astype(st["v"].dtype), (0, index, 0, 0)))
-        ctx.put_state(self._path, dict(st, index=index + t))
-        tmax = st["k"].shape[1]
-        qpos = index + jnp.arange(t)[:, None]           # (t, 1) global
-        kpos = jnp.arange(tmax)[None, :]                # (1, Tmax)
-        mask = kpos <= qpos                             # causal + unwritten
+            if int8_cache:
+                st = dict(
+                    st,
+                    k=jax.lax.dynamic_update_slice(st["k"], kq,
+                                                   (0, index, 0, 0)),
+                    v=jax.lax.dynamic_update_slice(st["v"], vq,
+                                                   (0, index, 0, 0)),
+                    k_scale=jax.lax.dynamic_update_slice(
+                        st["k_scale"], ks, (0, index, 0)),
+                    v_scale=jax.lax.dynamic_update_slice(
+                        st["v_scale"], vs, (0, index, 0)))
+            else:
+                st = dict(
+                    st,
+                    k=jax.lax.dynamic_update_slice(
+                        st["k"], k.astype(st["k"].dtype), (0, index, 0, 0)),
+                    v=jax.lax.dynamic_update_slice(
+                        st["v"], v.astype(st["v"].dtype), (0, index, 0, 0)))
+            ctx.put_state(self._path, dict(st, index=index + t))
+            tmax = st["k"].shape[1]
+            qpos = index + jnp.arange(t)[:, None]           # (t, 1) global
+            kpos = jnp.arange(tmax)[None, :]                # (1, Tmax)
+            mask = kpos <= qpos                             # causal + unwritten
         if not int8_cache:
             return scaled_dot_product_attention(
                 q, st["k"].astype(q.dtype), st["v"].astype(q.dtype),
@@ -283,7 +319,8 @@ class MultiheadSelfAttention(Module):
         s = jnp.einsum("bthd,bshd->bhts", q, st["k"].astype(q.dtype),
                        preferred_element_type=jnp.float32)
         s = s * sm * jnp.transpose(st["k_scale"], (0, 2, 1))[:, :, None, :]
-        s = jnp.where(mask[None, None], s, -jnp.inf)
+        s = jnp.where(mask if mask.ndim == 4 else mask[None, None],
+                      s, -jnp.inf)
         p = jax.nn.softmax(s, axis=-1)
         pv = (p * jnp.transpose(st["v_scale"], (0, 2, 1))[:, :, None, :]
               ).astype(q.dtype)

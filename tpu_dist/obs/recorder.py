@@ -84,7 +84,7 @@ def _generation() -> int:
 # (parallel: the ZeroOptimizer / DDP wrappers issue collectives from inside
 # tpu_dist.parallel — the user's line is their caller's, e.g. the train loop)
 _SITE_SKIP = ("collectives", "obs", "analysis", "dist", "resilience",
-              "parallel", "optim")
+              "parallel", "optim", "serve")
 
 
 def call_site(skip_parts=_SITE_SKIP) -> str:

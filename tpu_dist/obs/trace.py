@@ -157,6 +157,22 @@ def diagnose(dumps: List[dict]) -> dict:
            "missing_ranks": sorted(set(range(world)) - set(ranks)),
            # a crash/signal dump with no collectives is NOT a healthy run
            "clean_exit": all(d.get("reason") == "exit" for d in dumps)}
+    # serving ranks: a request's decode is not a lockstep collective, but a
+    # PENDING serve span in a dump is exactly "the request this rank was
+    # working on when it died/hung" — name it (tpu_dist.serve opens one
+    # span per request with its queue/prefill/decode split)
+    stuck_requests = []
+    for dmp in dumps:
+        for e in dmp.get("events", []):
+            if e.get("kind") == "serve" and e.get("outcome") == "pending":
+                stuck_requests.append({
+                    "rank": dmp.get("rank", 0), "req": e.get("req"),
+                    "phase": ("decode" if e.get("slot") is not None
+                              else "queued"),
+                    "slot": e.get("slot"),
+                    "prompt_len": e.get("prompt_len"),
+                    "site": e.get("site")})
+    out["stuck_requests"] = stuck_requests
     stuck_ref = ranks[waiting[0]] if waiting else None
     if front < 0:
         out.update({"verdict": "no-collectives", "straggler": None})
@@ -239,6 +255,15 @@ def render_diagnosis(d: dict) -> str:
     if d.get("missing_ranks"):
         lines.append(f"  WARNING: no dump from rank(s) {d['missing_ranks']} "
                      f"(world {d.get('world')})")
+    for sr in d.get("stuck_requests", []):
+        lines.append(
+            f"  stuck request: rank {sr['rank']} req {sr['req']} "
+            f"({sr['phase']}"
+            + (f", slot {sr['slot']}" if sr.get("slot") is not None else "")
+            + (f", prompt {sr['prompt_len']} tokens"
+               if sr.get("prompt_len") is not None else "")
+            + ") never completed"
+            + (f" — submitted at {sr['site']}" if sr.get("site") else ""))
     for r in sorted(d.get("ranks", {})):
         lines.append(_rank_line(r, d["ranks"][r]))
     return "\n".join(lines)

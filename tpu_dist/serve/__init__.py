@@ -1,0 +1,37 @@
+"""tpu_dist.serve — continuous-batching LM serving (ROADMAP item 4).
+
+The throughput half of the serving story over the existing stack:
+
+- :class:`SlotEngine` (engine.py): fixed pool of KV-cache slots with
+  per-slot lengths — requests are admitted into free slots *between*
+  decode iterations while other requests keep decoding (no
+  run-to-completion barrier), via the two compiled programs
+  ``TransformerLM.prefill_into_slot`` / ``decode_step``.
+- :class:`Scheduler` (scheduler.py): bounded admission queue, background
+  prompt staging (the ``DeviceLoader`` discipline), deadline-bounded
+  batching window, drain protocol for preemption.
+- :class:`Frontend` / :class:`Gateway` (frontend.py): length-socket frame
+  protocol on the data plane's frame discipline; the gateway is the
+  client-facing role ``python -m tpu_dist.launch --serve`` runs alongside
+  the model ranks and keeps traffic flowing across supervised restarts.
+- :class:`ServeClient` (client.py): streaming handles whose terminal
+  state is always reached — tokens + done, or a NAMED error.
+
+See docs/serving.md for the slot lifecycle, scheduler policy, knobs and
+measured numbers; ``benchmarks/bench_serve.py`` for the QPS/latency
+benchmark and the tier-1 smoke gate.
+"""
+
+from .client import RequestFailedError, ServeClient, ServerGoneError
+from .engine import (QueueFullError, Request, RequestHandle,
+                     SchedulerClosedError, SchedulerDrainingError,
+                     ServeError, SlotEngine)
+from .frontend import (BACKEND_KEY, GATEWAY_KEY, Frontend, Gateway,
+                       store_from_env)
+from .scheduler import Scheduler
+
+__all__ = ["SlotEngine", "Scheduler", "Frontend", "Gateway", "ServeClient",
+           "Request", "RequestHandle", "ServeError", "QueueFullError",
+           "SchedulerDrainingError", "SchedulerClosedError",
+           "RequestFailedError", "ServerGoneError",
+           "BACKEND_KEY", "GATEWAY_KEY", "store_from_env"]

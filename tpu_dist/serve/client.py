@@ -1,0 +1,180 @@
+"""Client for the serve frontend/gateway — streaming handles, named errors.
+
+The client enforces the layer's no-silent-drop contract from its side:
+every :meth:`ServeClient.submit` returns a
+:class:`~tpu_dist.serve.engine.RequestHandle` that ALWAYS terminates —
+with the token stream and ``done``, with the server's named error
+(:class:`RequestFailedError` carrying the server-side exception name,
+e.g. ``BackendGoneError`` when the model rank was killed mid-request), or
+with :class:`ServerGoneError` when the connection itself died with
+requests outstanding.  ``wait_done(timeout)`` is deadline-bounded, so a
+vanished server can never hang a caller.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from .engine import RequestHandle, ServeError
+from .frontend import connect_hello, read_frame, send_frame
+
+__all__ = ["ServeClient", "RequestFailedError", "ServerGoneError"]
+
+
+class RequestFailedError(ServeError):
+    """The server answered this request with an error frame.  ``error``
+    is the server-side exception name (``BackendGoneError``,
+    ``SchedulerDrainingError``, ``QueueFullError``, ...), ``detail`` its
+    message."""
+
+    def __init__(self, error: str, detail: str = ""):
+        self.error = error
+        self.detail = detail
+        super().__init__(f"{error}: {detail}" if detail else error)
+
+
+class ServerGoneError(ServeError):
+    """The connection to the serving frontend died with this request in
+    flight — the request's fate is unknown, which the client reports
+    loudly instead of leaving the handle pending forever."""
+
+
+class ServeClient:
+    """Socket client for a :class:`~tpu_dist.serve.frontend.Frontend` or
+    :class:`~tpu_dist.serve.frontend.Gateway`.
+
+    ``connect_retry`` bounds a retry window for the initial connection
+    (a gateway that is still binding, a backend mid-restart); 0 tries
+    once.  Thread-safe: submits may come from any thread, one reader
+    thread dispatches response frames to the per-request handles.
+    """
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0,
+                 connect_retry: float = 0.0):
+        self.host, self.port = host, int(port)
+        self.timeout = float(timeout)
+        deadline = time.monotonic() + max(0.0, connect_retry)
+        while True:
+            try:
+                self._sock = connect_hello(host, port, timeout=timeout)
+                break
+            except (OSError, ConnectionError):
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.2)
+        self._send_mu = threading.Lock()
+        self._mu = threading.Lock()
+        self._handles: Dict[int, RequestHandle] = {}
+        self._next_id = 1
+        self._closed = False
+        self._reader = threading.Thread(target=self._read_loop, daemon=True,
+                                        name="tpu_dist-serve-client")
+        self._reader.start()
+
+    # -- API -----------------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int = 16,
+               temperature: float = 0.0, eos_id: Optional[int] = None,
+               seed: int = 0) -> RequestHandle:
+        """Send one request; returns its streaming handle.  Raises
+        :class:`ServerGoneError` if the connection is already dead."""
+        with self._mu:
+            if self._closed:
+                raise ServerGoneError("client is closed")
+            rid = self._next_id
+            self._next_id += 1
+            handle = RequestHandle(rid)
+            self._handles[rid] = handle
+        frame = {"type": "submit", "id": rid,
+                 "prompt": [int(t) for t in prompt],
+                 "max_new_tokens": int(max_new_tokens),
+                 "temperature": float(temperature),
+                 "eos_id": None if eos_id is None else int(eos_id),
+                 "seed": int(seed)}
+        try:
+            send_frame(self._sock, frame, lock=self._send_mu)
+        except (OSError, ConnectionError) as e:
+            self._fail_all(ServerGoneError(
+                f"connection to {self.host}:{self.port} lost: {e!r}"))
+            raise self._handles_error()
+        return handle
+
+    def generate(self, prompt, max_new_tokens: int = 16,
+                 timeout: float = 120.0, **kw) -> list:
+        """Blocking convenience: submit and wait for the full token list."""
+        return self.submit(prompt, max_new_tokens, **kw).wait_done(timeout)
+
+    def pending(self) -> int:
+        with self._mu:
+            return len(self._handles)
+
+    def close(self) -> None:
+        with self._mu:
+            if self._closed:
+                return
+            self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._fail_all(ServerGoneError("client closed with the request "
+                                       "still in flight"))
+
+    def __enter__(self) -> "ServeClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- reader --------------------------------------------------------------
+
+    def _handles_error(self) -> ServerGoneError:
+        return ServerGoneError(
+            f"connection to {self.host}:{self.port} lost")
+
+    def _fail_all(self, exc: ServeError) -> None:
+        """Connection death: every in-flight handle terminates with the
+        named error — no handle is ever left pending forever."""
+        with self._mu:
+            self._closed = True
+            handles, self._handles = list(self._handles.values()), {}
+        for h in handles:
+            h._on_error(exc)
+
+    def _read_loop(self) -> None:
+        detail = "server closed the connection"
+        try:
+            while True:
+                frame = read_frame(self._sock)
+                if frame is None:
+                    break
+                self._dispatch(frame)
+        except (OSError, ConnectionError) as e:
+            detail = repr(e)
+        with self._mu:
+            closed = self._closed
+        if closed:
+            return  # local close(): close() already failed the handles
+        self._fail_all(ServerGoneError(
+            f"connection to {self.host}:{self.port} lost with requests in "
+            f"flight: {detail}"))
+
+    def _dispatch(self, frame: dict) -> None:
+        kind = frame.get("type")
+        rid = frame.get("id")
+        with self._mu:
+            handle = self._handles.get(rid)
+            if kind in ("done", "error") and rid in self._handles:
+                del self._handles[rid]
+        if handle is None:
+            return  # response for a request we no longer track
+        if kind == "token":
+            handle._on_token(frame["t"])
+        elif kind == "done":
+            handle._on_done(frame.get("reason", "length"))
+        elif kind == "error":
+            handle._on_error(RequestFailedError(
+                frame.get("error", "UnknownError"),
+                frame.get("detail", "")))

@@ -1,0 +1,554 @@
+"""Slot-based continuous-batching decode engine.
+
+The serving counterpart of ``TransformerLM.generate()`` (ROADMAP item 4):
+where ``generate`` runs one batch to completion — every sequence occupies
+its row until the LONGEST one finishes — the :class:`SlotEngine` owns a
+fixed pool of ``num_slots`` KV-cache rows with *per-slot* lengths and
+admits a new request into any free slot **between decode iterations**,
+while the other slots keep decoding.  On a mixed-length workload (short
+and long prompts, varied ``max_new_tokens``) that removes the
+run-to-completion barrier that leaves most of a static batch idle
+(measured ≥2x aggregate tokens/sec, ``benchmarks/bench_serve.py``).
+
+Two compiled programs drive the pool (tpu_dist/models/transformer.py):
+
+- ``prefill_into_slot``: one request's (bucket-padded) prompt fills ONE
+  cache slot in a single forward — the other slots' rows are untouched,
+  so admission never disturbs in-flight decodes.  One padded length = one
+  XLA program; prompt lengths are padded to power-of-two buckets to bound
+  retraces (padding K/V is masked or overwritten before it is ever
+  attended — token-identical to the unpadded prefill, tested).
+- ``decode_step``: ONE batched iteration over the whole pool — each slot
+  appends at its own length and samples its next token on device.  This
+  is the same method ``generate``'s scan runs, so serving output is
+  token-identical to offline generation (the ``--smoke`` gate pins it).
+
+The engine is deliberately single-threaded (the scheduler's loop thread
+drives ``admit``/``step``); everything thread-sensitive (handles,
+queues) lives in :mod:`tpu_dist.serve.scheduler`.
+
+Per-request observability: when the flight recorder is armed
+(``TPU_DIST_OBS=1``) every request opens a ``serve`` span at submit and
+stamps its queue / prefill / decode split onto it, so a crash dump (or
+``python -m tpu_dist.obs diagnose``) names the request a stuck server was
+working on — not just "the rank is busy".
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ..utils.metrics import LatencyHistogram
+
+__all__ = ["SlotEngine", "Request", "RequestHandle", "ServeError",
+           "QueueFullError", "SchedulerDrainingError",
+           "SchedulerClosedError"]
+
+
+class ServeError(RuntimeError):
+    """Base class for named serving-layer failures — every request the
+    layer cannot complete fails with a subclass of this (never silently)."""
+
+
+class QueueFullError(ServeError):
+    """The admission queue is at capacity: the caller should shed load or
+    retry after a backoff (the bounded queue IS the backpressure)."""
+
+
+class SchedulerDrainingError(ServeError):
+    """The scheduler is draining (preemption notice): it finishes in-flight
+    requests but admits no new ones."""
+
+
+class SchedulerClosedError(ServeError):
+    """The scheduler shut down with this request still queued or decoding:
+    the request did not complete, and this names why."""
+
+
+def _now() -> float:
+    return time.perf_counter()
+
+
+class RequestHandle:
+    """Caller-side future for one request: the token stream plus terminal
+    state.  Every submitted handle terminates — with ``done`` or with a
+    named error — the layer never drops a request silently.
+
+    Thread-safe.  ``wait_done(timeout)`` blocks for the terminal state and
+    re-raises the captured error (deadline-bounded: a dead server turns
+    into ``TimeoutError``, not a hang).  ``iter_tokens`` yields tokens as
+    they stream in.
+    """
+
+    def __init__(self, req_id: int):
+        import threading
+        self.id = req_id
+        self._cv = threading.Condition()
+        self._tokens: List[int] = []
+        self._reason: Optional[str] = None
+        self._error: Optional[BaseException] = None
+
+    # -- producer side (engine/scheduler/client reader) ----------------------
+
+    def _on_token(self, token: int) -> None:
+        with self._cv:
+            self._tokens.append(int(token))
+            self._cv.notify_all()
+
+    def _on_done(self, reason: str) -> None:
+        with self._cv:
+            self._reason = reason
+            self._cv.notify_all()
+
+    def _on_error(self, exc: BaseException) -> None:
+        with self._cv:
+            if self._reason is None and self._error is None:
+                self._error = exc
+            self._cv.notify_all()
+
+    # -- consumer side -------------------------------------------------------
+
+    @property
+    def done(self) -> bool:
+        with self._cv:
+            return self._reason is not None or self._error is not None
+
+    @property
+    def reason(self) -> Optional[str]:
+        """Terminal reason ('eos' | 'length'), None while running/failed."""
+        with self._cv:
+            return self._reason
+
+    @property
+    def error(self) -> Optional[BaseException]:
+        with self._cv:
+            return self._error
+
+    def tokens(self) -> List[int]:
+        """Snapshot of the tokens streamed so far."""
+        with self._cv:
+            return list(self._tokens)
+
+    def wait_done(self, timeout: float) -> List[int]:
+        """Block until the request terminates; returns the generated tokens
+        or re-raises the named failure.  ``TimeoutError`` after ``timeout``
+        seconds — never an unbounded hang."""
+        deadline = _now() + timeout
+        with self._cv:
+            while self._reason is None and self._error is None:
+                left = deadline - _now()
+                if left <= 0:
+                    raise TimeoutError(
+                        f"request {self.id} not finished after "
+                        f"{timeout:.1f}s ({len(self._tokens)} tokens so "
+                        f"far)")
+                self._cv.wait(left)
+            if self._error is not None:
+                raise self._error
+            return list(self._tokens)
+
+    def iter_tokens(self, timeout: float = 60.0):
+        """Yield tokens as they stream in; raises the request's named error
+        (or ``TimeoutError`` when ``timeout`` passes with no progress)."""
+        i = 0
+        while True:
+            with self._cv:
+                deadline = _now() + timeout
+                while (i >= len(self._tokens) and self._reason is None
+                       and self._error is None):
+                    left = deadline - _now()
+                    if left <= 0:
+                        raise TimeoutError(
+                            f"request {self.id}: no token progress in "
+                            f"{timeout:.1f}s")
+                    self._cv.wait(left)
+                if i < len(self._tokens):
+                    tok = self._tokens[i]
+                else:
+                    if self._error is not None:
+                        raise self._error
+                    return
+            i += 1
+            yield tok
+
+
+class Request:
+    """One decode request moving through the serving layer."""
+
+    _ids = iter(range(1, 1 << 62))
+
+    def __init__(self, prompt, max_new_tokens: int,
+                 temperature: float = 0.0, eos_id: Optional[int] = None,
+                 seed: int = 0, req_id: Optional[int] = None,
+                 on_token: Optional[Callable] = None,
+                 on_done: Optional[Callable] = None,
+                 on_error: Optional[Callable] = None):
+        self.id = req_id if req_id is not None else next(Request._ids)
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.eos_id = None if eos_id is None else int(eos_id)
+        self.seed = int(seed)
+        self.on_token = on_token
+        self.on_done = on_done
+        self.on_error = on_error
+        self.t_submit = _now()
+        self.t_admit: Optional[float] = None
+        self.t_first: Optional[float] = None
+        self.emitted = 0
+        self.staged = None          # (padded device/np prompt, bucket len)
+        self.obs_span = None        # armed flight-recorder span (or None)
+
+    def emit(self, token: int) -> None:
+        self.emitted += 1
+        if self.t_first is None:
+            self.t_first = _now()
+        if self.on_token is not None:
+            self.on_token(self, int(token))
+
+    def finish(self, reason: str) -> None:
+        if self.on_done is not None:
+            self.on_done(self, reason)
+
+    def fail(self, exc: BaseException) -> None:
+        if self.on_error is not None:
+            self.on_error(self, exc)
+
+
+def _bucket_lengths(max_prompt: int, min_bucket: int = 16) -> List[int]:
+    """Power-of-two padded-prompt lengths up to ``max_prompt`` (always
+    includes ``max_prompt`` itself): one compiled prefill per bucket."""
+    out = []
+    b = min_bucket
+    while b < max_prompt:
+        out.append(b)
+        b *= 2
+    out.append(max_prompt)
+    return out
+
+
+class SlotEngine:
+    """Fixed pool of ``num_slots`` KV-cache slots with per-slot lengths.
+
+    Drive it from ONE thread (the scheduler loop): ``admit(request)``
+    prefills a free slot between decode iterations, ``step()`` decodes
+    every active slot one token.  EOS and per-request ``max_new_tokens``
+    free slots immediately — the freed slot is admissible on the very next
+    iteration.
+    """
+
+    def __init__(self, model, params, num_slots: int = 8,
+                 max_len: Optional[int] = None, cache_dtype=None,
+                 min_bucket: int = 16):
+        import jax
+        import jax.numpy as jnp
+
+        self.model = model
+        self.params = params
+        self.num_slots = int(num_slots)
+        self.max_len = int(max_len if max_len is not None
+                           else model.max_seq_len)
+        if self.max_len > model.max_seq_len:
+            raise ValueError(f"max_len {self.max_len} exceeds the model's "
+                             f"max_seq_len {model.max_seq_len}")
+        self.cache_dtype = cache_dtype or jnp.float32
+        self.buckets = _bucket_lengths(self.max_len, min_bucket)
+        self._jnp = jnp
+        self.cache = model.init_slot_cache(self.num_slots, self.max_len,
+                                           self.cache_dtype)
+
+        # host-side slot table — THE source of truth for occupancy
+        self.lengths = np.zeros(self.num_slots, np.int32)
+        self.tokens = np.zeros(self.num_slots, np.int32)
+        self.temps = np.zeros(self.num_slots, np.float32)
+        self.keys = np.zeros((self.num_slots, 2), np.uint32)
+        self.steps = np.ones(self.num_slots, np.int32)
+        self.active = np.zeros(self.num_slots, bool)
+        self.slot_req: List[Optional[Request]] = [None] * self.num_slots
+
+        # latency split (shared streaming histograms, utils.metrics)
+        self.hist_queue = LatencyHistogram()
+        self.hist_prefill = LatencyHistogram()
+        self.hist_ttft = LatencyHistogram()
+        self.hist_token = LatencyHistogram()
+        self.hist_e2e = LatencyHistogram()
+        self.completed = 0
+        self.generated_tokens = 0
+        self._occupied_slot_steps = 0
+        self._decode_steps = 0
+
+        def _decode_fn(params, cache, tokens, lengths, temps, keys, steps,
+                       sampling):
+            logits, cache = model.decode_step(params, tokens, lengths,
+                                              cache)
+            return self._sample(logits, temps, keys, steps, sampling), cache
+
+        def _prefill_fn(params, cache, prompt, length, slot, temp, key,
+                        sampling):
+            logits, cache = model.prefill_into_slot(params, prompt, length,
+                                                    slot, cache)
+            tok = self._sample(logits[None], temp[None], key[None],
+                               jnp.zeros((1,), jnp.int32), sampling)
+            return tok[0], cache
+
+        # the cache is donated (the pool buffer is updated in place instead
+        # of copied every token); ``sampling`` is STATIC — jit caches by
+        # shape, so whether any slot samples must key the program cache,
+        # not be read from host state at trace time
+        self._decode = jax.jit(_decode_fn, donate_argnums=(1,),
+                               static_argnums=(7,))
+        self._prefill = jax.jit(_prefill_fn, donate_argnums=(1,),
+                                static_argnums=(7,))
+
+    # -- sampling (traced) ---------------------------------------------------
+
+    def _sample(self, logits, temps, keys, steps, sampling: bool):
+        """Per-slot next token: greedy argmax at temperature 0 (the parity
+        mode the smoke gate cross-checks against ``generate``), categorical
+        at temperature > 0 with a per-request key folded by step — the same
+        ``fold_in(key, step)`` schedule ``generate`` uses, so a
+        single-request engine run with the same key reproduces it.
+        ``sampling`` is a static flag: the all-greedy pool (the common
+        case) compiles without the sampling branch at all."""
+        import jax
+        import jax.numpy as jnp
+
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if not sampling:
+            return greedy
+
+        def one(key, step, row, temp):
+            return jax.random.categorical(
+                jax.random.fold_in(key, step),
+                row / jnp.maximum(temp, 1e-6))
+
+        sampled = jax.vmap(one)(keys, steps, logits, temps)
+        return jnp.where(temps > 0, sampled.astype(jnp.int32), greedy)
+
+    # -- introspection -------------------------------------------------------
+
+    def free_slots(self) -> int:
+        return int(self.num_slots - self.active.sum())
+
+    def active_count(self) -> int:
+        return int(self.active.sum())
+
+    def idle(self) -> bool:
+        return not self.active.any()
+
+    def occupancy(self) -> float:
+        """Mean fraction of slots busy per decode step."""
+        if self._decode_steps == 0:
+            return 0.0
+        return (self._occupied_slot_steps
+                / (self._decode_steps * self.num_slots))
+
+    def bucket_for(self, prompt_len: int) -> int:
+        for b in self.buckets:
+            if b >= prompt_len:
+                return b
+        raise ValueError(f"prompt length {prompt_len} exceeds the pool's "
+                         f"max_len {self.max_len}")
+
+    def validate(self, prompt_len: int, max_new_tokens: int) -> None:
+        if prompt_len < 1:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got "
+                             f"{max_new_tokens}")
+        if prompt_len + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({prompt_len}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds the slot capacity "
+                f"({self.max_len})")
+
+    def stage(self, req: Request):
+        """Bucket-pad (and device-stage) a request's prompt — the work the
+        scheduler's background staging thread runs off the decode loop."""
+        import jax
+
+        bucket = self.bucket_for(len(req.prompt))
+        padded = np.zeros(bucket, np.int32)
+        padded[:len(req.prompt)] = req.prompt
+        req.staged = jax.device_put(padded)
+        return req.staged
+
+    # -- the two pool operations --------------------------------------------
+
+    def admit(self, req: Request) -> int:
+        """Prefill ``req`` into a free slot and emit its first token.
+        Returns the slot index; raises ``RuntimeError`` when no slot is
+        free (callers check :meth:`free_slots` first)."""
+        free = np.flatnonzero(~self.active)
+        if len(free) == 0:
+            raise RuntimeError("no free slot (check free_slots() first)")
+        slot = int(free[0])
+        self.validate(len(req.prompt), req.max_new_tokens)
+        req.t_admit = _now()
+        self.hist_queue.observe(req.t_admit - req.t_submit)
+        staged = req.staged if req.staged is not None else self.stage(req)
+
+        import jax
+        key = np.asarray(
+            jax.random.key_data(jax.random.key(req.seed)), np.uint32)
+        tok_dev, self.cache = self._prefill(
+            self.params, self.cache, staged,
+            np.int32(len(req.prompt)), np.int32(slot),
+            np.float32(req.temperature), key, req.temperature > 0)
+        tok = int(tok_dev)
+        t_pf = _now()
+        self.hist_prefill.observe(t_pf - req.t_admit)
+
+        self.lengths[slot] = len(req.prompt)
+        self.tokens[slot] = tok
+        self.temps[slot] = req.temperature
+        self.keys[slot] = key
+        self.steps[slot] = 1
+        self.active[slot] = True
+        self.slot_req[slot] = req
+        self._obs_admit(req, slot, t_pf)
+
+        req.emit(tok)
+        self.hist_ttft.observe(_now() - req.t_submit)
+        self.generated_tokens += 1
+        self._maybe_finish(slot, tok)
+        return slot
+
+    def step(self) -> int:
+        """One decode iteration over the pool; returns tokens emitted."""
+        if not self.active.any():
+            return 0
+        t0 = _now()
+        nxt_dev, self.cache = self._decode(
+            self.params, self.cache, self.tokens, self.lengths,
+            self.temps, self.keys, self.steps,
+            bool(np.any(self.temps > 0)))
+        nxt = np.asarray(nxt_dev)
+        dt = _now() - t0
+        n_active = int(self.active.sum())
+        self._decode_steps += 1
+        self._occupied_slot_steps += n_active
+        self.hist_token.observe(dt)
+
+        emitted = 0
+        for slot in np.flatnonzero(self.active):
+            slot = int(slot)
+            req = self.slot_req[slot]
+            tok = int(nxt[slot])
+            self.lengths[slot] += 1
+            self.steps[slot] += 1
+            self.tokens[slot] = tok
+            req.emit(tok)
+            self.generated_tokens += 1
+            emitted += 1
+            self._maybe_finish(slot, tok)
+        return emitted
+
+    # -- completion / failure ------------------------------------------------
+
+    def _maybe_finish(self, slot: int, token: int) -> None:
+        req = self.slot_req[slot]
+        if req.eos_id is not None and token == req.eos_id:
+            self._finish(slot, "eos")
+        elif req.emitted >= req.max_new_tokens:
+            self._finish(slot, "length")
+
+    def _finish(self, slot: int, reason: str) -> None:
+        req = self.slot_req[slot]
+        self._free(slot)
+        self.completed += 1
+        self.hist_e2e.observe(_now() - req.t_submit)
+        self._obs_end(req, "ok", reason=reason)
+        req.finish(reason)
+
+    def fail_slot(self, slot: int, exc: BaseException) -> None:
+        """Free a slot whose request failed; the request is notified with
+        the named error (scheduler error paths)."""
+        req = self.slot_req[slot]
+        self._free(slot)
+        if req is not None:
+            self._obs_end(req, f"error:{type(exc).__name__}")
+            req.fail(exc)
+
+    def fail_all(self, exc: BaseException) -> None:
+        for slot in np.flatnonzero(self.active):
+            self.fail_slot(int(slot), exc)
+
+    def _free(self, slot: int) -> None:
+        self.active[slot] = False
+        self.lengths[slot] = 0
+        self.tokens[slot] = 0
+        self.temps[slot] = 0.0
+        self.slot_req[slot] = None
+
+    # -- per-request obs spans ----------------------------------------------
+
+    @staticmethod
+    def obs_open(req: Request) -> None:
+        """Open the request's flight-recorder span (armed runs only) —
+        called at SUBMIT time so queue time is on the span from the start;
+        a request stuck in the queue is still a named pending span."""
+        from ..obs.recorder import call_site, get_recorder
+        rec = get_recorder()
+        if rec is None:
+            return
+        req.obs_span = rec.begin("serve", "request", req=req.id,
+                                 prompt_len=int(len(req.prompt)),
+                                 max_new_tokens=req.max_new_tokens,
+                                 site=call_site())
+
+    def _obs_admit(self, req: Request, slot: int, t_prefill_done) -> None:
+        if req.obs_span is None:
+            return
+        from ..obs.recorder import get_recorder
+        rec = get_recorder()
+        if rec is None:
+            return
+        rec.update_event(
+            req.obs_span, slot=slot,
+            queue_ns=int((req.t_admit - req.t_submit) * 1e9),
+            prefill_ns=int((t_prefill_done - req.t_admit) * 1e9))
+
+    def _obs_end(self, req: Request, outcome: str, **fields) -> None:
+        if req.obs_span is None:
+            return
+        from ..obs.recorder import get_recorder
+        rec = get_recorder()
+        if rec is None:
+            return
+        decode_ns = 0
+        if req.t_first is not None:
+            decode_ns = int((_now() - req.t_first) * 1e9)
+        rec.end(req.obs_span, outcome=outcome, tokens=req.emitted,
+                decode_ns=decode_ns, **fields)
+
+    # -- aggregate stats -----------------------------------------------------
+
+    def reset_stats(self) -> None:
+        """Zero the histograms/counters (benchmarks: exclude warmup
+        compiles from the measured window).  Slot state is untouched."""
+        self.hist_queue = LatencyHistogram()
+        self.hist_prefill = LatencyHistogram()
+        self.hist_ttft = LatencyHistogram()
+        self.hist_token = LatencyHistogram()
+        self.hist_e2e = LatencyHistogram()
+        self.completed = 0
+        self.generated_tokens = 0
+        self._occupied_slot_steps = 0
+        self._decode_steps = 0
+
+    def stats(self) -> dict:
+        return {
+            "completed": self.completed,
+            "generated_tokens": self.generated_tokens,
+            "decode_steps": self._decode_steps,
+            "occupancy": round(self.occupancy(), 4),
+            "queue": self.hist_queue.summary(),
+            "prefill": self.hist_prefill.summary(),
+            "ttft": self.hist_ttft.summary(),
+            "decode_step": self.hist_token.summary(),
+            "e2e": self.hist_e2e.summary(),
+        }

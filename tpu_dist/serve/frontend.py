@@ -1,0 +1,477 @@
+"""Socket frontend + gateway for the serving engine.
+
+Wire format — the data plane's frame discipline
+(tpu_dist/collectives/transport.py) applied to request traffic: a fixed
+hello (magic + protocol version), then length-prefixed JSON frames
+(``u32 length || utf-8 JSON``), sent with the same vectored ``_sendv``
+and read with the same ``_recv_exact`` the p2p transport uses — no
+pickle, bounded reads, EOF at a frame boundary is a clean close and EOF
+mid-frame is a named ``ConnectionError``.
+
+Frames client → server::
+
+    {"type": "submit", "id": <int>, "prompt": [ints],
+     "max_new_tokens": N, "temperature": 0.0, "eos_id": null, "seed": 0}
+
+Frames server → client (streamed per request, interleaved across
+requests as the engine emits them)::
+
+    {"type": "token", "id": <int>, "t": <int>}
+    {"type": "done",  "id": <int>, "reason": "eos"|"length", "n": <int>}
+    {"type": "error", "id": <int>, "error": "<ExceptionName>",
+     "detail": "..."}
+
+Two roles live here:
+
+- :class:`Frontend` — the engine-side listener (runs in the model-rank
+  process next to the :class:`~tpu_dist.serve.scheduler.Scheduler`).
+  Publishes its address to the control-plane store under
+  ``tpu_dist/serve/backend`` so the gateway finds it across restarts.
+- :class:`Gateway` — the client-facing role ``python -m tpu_dist.launch
+  --serve`` spawns ALONGSIDE the model ranks (the thin role split,
+  ROADMAP item 5's stepping stone).  It owns the stable public port,
+  proxies frames to the current backend, and when the model rank dies it
+  fails that connection's in-flight requests with a named
+  ``BackendGoneError`` frame — never silently — then reconnects to the
+  restarted backend (fresh address read from the store) on the next
+  submit, so traffic resumes across supervised restarts while clients
+  keep their connection.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from ..collectives.transport import _recv_exact, _sendv, _tune_socket
+from .scheduler import Scheduler
+
+__all__ = ["Frontend", "Gateway", "BACKEND_KEY", "GATEWAY_KEY",
+           "connect_hello", "read_frame", "send_frame"]
+
+_MAGIC = b"TPSV"
+_HELLO = struct.Struct("<4sH")   # magic, protocol version
+_VERSION = 1
+_U32 = struct.Struct("<I")
+_MAX_FRAME = 64 << 20
+
+# cross-generation service-discovery keys (like tpu_dist/master_port):
+# written by whichever incarnation currently owns the role, read by the
+# other side on (re)connect — deliberately OUTSIDE the g{gen} namespace so
+# a restarted backend's fresh address survives the generation reaper
+BACKEND_KEY = "tpu_dist/serve/backend"
+GATEWAY_KEY = "tpu_dist/serve/gateway"
+
+
+def send_frame(sock, obj: dict, lock: Optional[threading.Lock] = None) -> None:
+    """One length-prefixed JSON frame, vectored send (header + payload in
+    one syscall).  ``lock`` serializes concurrent writers on a shared
+    connection (token frames for different requests interleave)."""
+    payload = json.dumps(obj).encode()
+    header = _U32.pack(len(payload))
+    if lock is None:
+        _sendv(sock, header, payload)
+    else:
+        with lock:
+            _sendv(sock, header, payload)
+
+
+def read_frame(sock) -> Optional[dict]:
+    """Next frame, or None on EOF at a frame boundary (clean close).
+    Raises ``ConnectionError`` on a truncated frame or an oversized
+    length prefix (a desynced/hostile peer, not a request)."""
+    raw = _recv_exact(sock, _U32.size)
+    if raw is None:
+        return None
+    (n,) = _U32.unpack(bytes(raw))
+    if n > _MAX_FRAME:
+        raise ConnectionError(f"frame length {n} exceeds the "
+                              f"{_MAX_FRAME}-byte bound")
+    body = _recv_exact(sock, n)
+    if body is None:
+        raise ConnectionError("connection closed mid-frame")
+    return json.loads(bytes(body).decode())
+
+
+def connect_hello(host: str, port: int, timeout: float = 10.0):
+    """Open a serve-protocol connection: TCP connect + hello exchange.
+    Returns the connected socket; raises ``ConnectionError`` on a
+    version/magic mismatch (a non-serve listener on that port)."""
+    sock = socket.create_connection((host, int(port)), timeout=timeout)
+    _tune_socket(sock)
+    sock.settimeout(timeout)
+    sock.sendall(_HELLO.pack(_MAGIC, _VERSION))
+    raw = _recv_exact(sock, _HELLO.size)
+    if raw is None:
+        sock.close()
+        raise ConnectionError("peer closed during serve hello")
+    magic, ver = _HELLO.unpack(bytes(raw))
+    if magic != _MAGIC or ver != _VERSION:
+        sock.close()
+        raise ConnectionError(f"not a tpu_dist.serve peer "
+                              f"(magic={magic!r} version={ver})")
+    sock.settimeout(None)
+    return sock
+
+
+class _Listener:
+    """Shared accept-loop scaffolding for both roles."""
+
+    def __init__(self, host: str, port: int, name: str, backlog: int = 64):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, int(port)))
+        self._sock.listen(backlog)
+        self.host = host
+        self.port = self._sock.getsockname()[1]
+        self._closing = False
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name=name)
+
+    @property
+    def addr(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def _accept_loop(self) -> None:
+        while not self._closing:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            _tune_socket(conn)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True,
+                             name=self._accept_thread.name + "-conn").start()
+
+    def _serve_conn(self, conn) -> None:  # pragma: no cover - overridden
+        conn.close()
+
+    @staticmethod
+    def _hello(conn, timeout: float = 10.0) -> bool:
+        """Server side of the hello exchange; False on a non-serve peer."""
+        conn.settimeout(timeout)
+        try:
+            raw = _recv_exact(conn, _HELLO.size)
+            if raw is None:
+                return False
+            magic, ver = _HELLO.unpack(bytes(raw))
+            if magic != _MAGIC or ver != _VERSION:
+                return False
+            conn.sendall(_HELLO.pack(_MAGIC, _VERSION))
+        except (OSError, ConnectionError):
+            return False
+        conn.settimeout(None)
+        return True
+
+    def close(self) -> None:
+        self._closing = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class Frontend(_Listener):
+    """Engine-side frame server: accepts serve-protocol connections and
+    feeds the scheduler; per-request tokens stream back as they are
+    emitted.  A dead client's requests keep decoding (the engine does not
+    support mid-decode cancellation yet) but their frames are dropped at
+    the closed socket — bounded by the request's ``max_new_tokens``."""
+
+    def __init__(self, scheduler: Scheduler, host: str = "127.0.0.1",
+                 port: int = 0, store=None):
+        super().__init__(host, port, "tpu_dist-serve-frontend")
+        self.scheduler = scheduler
+        self._store = store
+        if store is not None:
+            # cross-restart service discovery: the gateway re-resolves this
+            # key when its backend connection dies
+            store.set(BACKEND_KEY, self.addr.encode())
+        self._accept_thread.start()
+
+    def _serve_conn(self, conn) -> None:
+        if not self._hello(conn):
+            conn.close()
+            return
+        send_mu = threading.Lock()
+        alive = [True]
+        handles: Dict[object, object] = {}  # rid -> RequestHandle: the
+        # submit handles stay owned (TD007) — errors also travel on them
+
+        def _send(obj: dict) -> None:
+            if not alive[0]:
+                return
+            try:
+                send_frame(conn, obj, lock=send_mu)
+            except (OSError, ConnectionError):
+                alive[0] = False   # client gone: stop pushing its frames
+
+        def _callbacks(rid):
+            def on_token(req, t):
+                _send({"type": "token", "id": rid, "t": t})
+
+            def on_done(req, reason):
+                handles.pop(rid, None)
+                _send({"type": "done", "id": rid, "reason": reason,
+                       "n": req.emitted})
+
+            def on_error(req, exc):
+                handles.pop(rid, None)
+                _send({"type": "error", "id": rid,
+                       "error": type(exc).__name__, "detail": str(exc)})
+
+            return on_token, on_done, on_error
+
+        try:
+            while not self._closing:
+                frame = read_frame(conn)
+                if frame is None:
+                    break
+                if frame.get("type") != "submit":
+                    _send({"type": "error", "id": frame.get("id"),
+                           "error": "ProtocolError",
+                           "detail": f"unknown frame type "
+                                     f"{frame.get('type')!r}"})
+                    continue
+                rid = frame.get("id")
+                on_token, on_done, on_error = _callbacks(rid)
+                try:
+                    handles[rid] = self.scheduler.submit(
+                        frame["prompt"],
+                        max_new_tokens=int(frame.get("max_new_tokens", 16)),
+                        temperature=float(frame.get("temperature", 0.0)),
+                        eos_id=frame.get("eos_id"),
+                        seed=int(frame.get("seed", 0)),
+                        req_id=rid, on_token=on_token, on_done=on_done,
+                        on_error=on_error)
+                    if handles[rid].done:
+                        # terminal callback raced the assignment: its pop
+                        # was a no-op, so reap here instead of leaking
+                        handles.pop(rid, None)
+                except Exception as e:
+                    _send({"type": "error", "id": rid,
+                           "error": type(e).__name__, "detail": str(e)})
+        except (OSError, ConnectionError):
+            pass
+        finally:
+            alive[0] = False
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+class BackendGoneError(ConnectionError):
+    """The gateway's model-rank connection died with requests in flight;
+    each such request was failed with an error frame naming this class."""
+
+
+class Gateway(_Listener):
+    """Client-facing role of the ``--serve`` split: stable public port,
+    per-connection proxy sessions to the current backend.
+
+    Backend resolution order: explicit ``backend`` address, else the
+    control-plane store's ``tpu_dist/serve/backend`` key — re-read on
+    every (re)connect, because a supervised restart gives the model rank
+    a fresh port.  A submit that cannot reach a backend within
+    ``backend_timeout`` fails with a named ``BackendUnavailableError``
+    frame; a backend dying mid-stream fails that session's in-flight
+    requests with ``BackendGoneError`` frames.  The session (and the
+    client's connection) survives either way — the next submit retries a
+    fresh backend, which is how traffic resumes after the chaos e2e's
+    SIGKILL."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0, store=None,
+                 backend: Optional[str] = None,
+                 backend_timeout: float = 60.0):
+        super().__init__(host, port, "tpu_dist-serve-gateway")
+        self._store = store
+        self._backend = backend
+        self.backend_timeout = float(backend_timeout)
+        if store is not None:
+            store.set(GATEWAY_KEY, f"{self._public_host()}:{self.port}"
+                      .encode())
+        self._accept_thread.start()
+
+    def _public_host(self) -> str:
+        """The address to PUBLISH for this gateway: a 0.0.0.0 bind is not
+        routable, so advertise the interface that routes toward the store
+        server — the SAME probe the data plane's address advertisement
+        uses (transport.store_routed_host), so the two roles can never
+        publish inconsistent interfaces."""
+        if self.host != "0.0.0.0":
+            return self.host
+        from ..collectives.transport import store_routed_host
+        return store_routed_host(self._store)
+
+    def _resolve_backend(self, deadline: float) -> Tuple[str, int]:
+        if self._backend:
+            host, _, port = self._backend.rpartition(":")
+            return host, int(port)
+        if self._store is None:
+            raise ConnectionError("gateway has neither --backend nor a "
+                                  "control-plane store to resolve one")
+        timeout = max(0.1, deadline - time.monotonic())
+        self._store.wait([BACKEND_KEY], timeout=timeout)
+        raw = self._store.get(BACKEND_KEY).decode()
+        host, _, port = raw.rpartition(":")
+        return host, int(port)
+
+    def _connect_backend(self):
+        """Bounded retry loop: the backend may be mid-restart.  Raises
+        ``ConnectionError`` after ``backend_timeout``."""
+        deadline = time.monotonic() + self.backend_timeout
+        last = None
+        while time.monotonic() < deadline:
+            try:
+                host, port = self._resolve_backend(deadline)
+                return connect_hello(host, port, timeout=5.0)
+            except (OSError, ConnectionError, TimeoutError) as e:
+                last = e
+                time.sleep(0.25)
+        raise ConnectionError(
+            f"no serving backend reachable within "
+            f"{self.backend_timeout:.0f}s (last error: {last!r})")
+
+    def _serve_conn(self, conn) -> None:
+        if not self._hello(conn):
+            conn.close()
+            return
+        sess = _GatewaySession(self, conn)
+        try:
+            sess.run()
+        finally:
+            sess.close()
+
+
+class _GatewaySession:
+    """One client connection's proxy state: the backend socket, the pump
+    thread reading backend frames, and the in-flight id set the no-silent-
+    drop guarantee is enforced over."""
+
+    def __init__(self, gw: Gateway, conn):
+        self.gw = gw
+        self.conn = conn
+        self._client_mu = threading.Lock()
+        self._mu = threading.Lock()
+        self._backend = None
+        self._backend_mu = threading.Lock()
+        # rid -> the backend SOCKET it was forwarded on: a dying backend's
+        # pump may run its orphan sweep after a reconnect has already
+        # forwarded new requests to the replacement — the sweep must only
+        # fail ids that rode the dead connection
+        self._inflight: Dict[object, object] = {}
+        self._closing = False
+
+    # -- client side ---------------------------------------------------------
+
+    def _to_client(self, obj: dict) -> None:
+        try:
+            send_frame(self.conn, obj, lock=self._client_mu)
+        except (OSError, ConnectionError):
+            self._closing = True
+
+    def run(self) -> None:
+        while not self._closing and not self.gw._closing:
+            try:
+                frame = read_frame(self.conn)
+            except (OSError, ConnectionError):
+                return
+            if frame is None:
+                return
+            if frame.get("type") != "submit":
+                self._to_client({"type": "error", "id": frame.get("id"),
+                                 "error": "ProtocolError",
+                                 "detail": f"unknown frame type "
+                                           f"{frame.get('type')!r}"})
+                continue
+            self._forward(frame)
+
+    def _forward(self, frame: dict) -> None:
+        rid = frame.get("id")
+        with self._backend_mu:
+            try:
+                if self._backend is None:
+                    self._backend = self.gw._connect_backend()
+                    threading.Thread(target=self._pump,
+                                     args=(self._backend,), daemon=True,
+                                     name="tpu_dist-serve-gw-pump").start()
+                with self._mu:
+                    self._inflight[rid] = self._backend
+                send_frame(self._backend, frame)
+            except (OSError, ConnectionError, TimeoutError) as e:
+                with self._mu:
+                    self._inflight.pop(rid, None)
+                self._drop_backend()
+                self._to_client({"type": "error", "id": rid,
+                                 "error": "BackendUnavailableError",
+                                 "detail": f"no serving backend: {e}"})
+
+    # -- backend side --------------------------------------------------------
+
+    def _pump(self, backend) -> None:
+        """Forward backend frames to the client until the backend dies;
+        then fail every in-flight request LOUDLY (BackendGoneError) — the
+        chaos e2e asserts no request in flight at a SIGKILL is silently
+        dropped."""
+        detail = "backend closed the connection"
+        try:
+            while True:
+                frame = read_frame(backend)
+                if frame is None:
+                    break
+                rid = frame.get("id")
+                if frame.get("type") in ("done", "error"):
+                    with self._mu:
+                        self._inflight.pop(rid, None)
+                self._to_client(frame)
+        except (OSError, ConnectionError) as e:
+            detail = repr(e)
+        with self._backend_mu:
+            if self._backend is backend:
+                self._backend = None
+        try:
+            backend.close()
+        except OSError:
+            pass
+        with self._mu:
+            orphans = [rid for rid, b in self._inflight.items()
+                       if b is backend]
+            for rid in orphans:
+                del self._inflight[rid]
+        for rid in orphans:
+            self._to_client({
+                "type": "error", "id": rid, "error": "BackendGoneError",
+                "detail": f"model rank died mid-request ({detail}); "
+                          f"resubmit after the supervised restart"})
+
+    def _drop_backend(self) -> None:
+        b, self._backend = self._backend, None
+        if b is not None:
+            try:
+                b.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._closing = True
+        with self._backend_mu:
+            self._drop_backend()
+        try:
+            self.conn.close()
+        except OSError:
+            pass
+
+
+def store_from_env(timeout: float = 30.0):
+    """Control-plane store client from the launcher's env contract
+    (``TPU_DIST_STORE_ADDR``), or None when absent — the gateway and the
+    serving worker both discover each other through it.  ONE parser of
+    that env contract exists (the heartbeat's); this re-exports it so the
+    serving role and the heartbeats can never resolve different stores."""
+    from ..resilience.heartbeat import _store_from_env
+    return _store_from_env(timeout=timeout)
