@@ -119,6 +119,25 @@ def _worker() -> int:
             os.environ["TPU_DIST_COMM_DTYPE"] = case["comm"]
         else:
             os.environ.pop("TPU_DIST_COMM_DTYPE", None)
+        # frame-integrity variant: checksum on (the default) vs off — the
+        # crc_overhead summary is their ratio.  Plain rows keep the
+        # environment default (armed), matching production.
+        if case.get("crc") is not None:
+            os.environ["TPU_DIST_FRAME_CRC"] = case["crc"]
+        else:
+            os.environ.pop("TPU_DIST_FRAME_CRC", None)
+        # wire emulation for the crc gate rows: BOTH arms paced to the
+        # same fixed rate by a netchaos slow-drip fault — the production
+        # regime is a wire-bound link where checksum arithmetic overlaps
+        # transfer; this box's loopback is CPU/memory-bound, so an
+        # unpaced comparison measures memory-bus contention (~1:1 for
+        # any added pass), not the deployed cost of integrity
+        from tpu_dist.resilience import netchaos as _netchaos
+        rate = case.get("wire_rate")
+        if rate:
+            _netchaos.install(f"slow-drip:surface=tcp,rate={int(rate)}")
+        else:
+            _netchaos.uninstall()
         # topology variants: algo picks the ring shape, shm the intra-host
         # payload transport.  Plain rows pin algo=flat + SHM off so the
         # baseline stays the flat TCP ring every prior round measured.
@@ -137,7 +156,8 @@ def _worker() -> int:
              .standard_normal(nbytes // 4).astype(np.float32))
         apply_case_env(case)
         out = run_op(op, x)  # warm-up: opens peer connections, primes numpy
-        if spec.get("check") and op == "all_reduce":
+        if spec.get("check") and op == "all_reduce" \
+                and case.get("crc") is None:
             # every rank takes the same branch (case fields are shared),
             # so the reference collectives stay rank-aligned
             if algo in ("hier", "flat_shm"):
@@ -195,11 +215,17 @@ def _worker() -> int:
                "world": world, "bytes": nbytes, "iters": iters,
                "reps": reps, "comm": comm or "f32", "algo": algo,
                "value": round(best, 2), "unit": "MB/s"}
+        if case.get("crc") is not None:
+            row["crc"] = case["crc"]
+            row["wire_mb_s"] = case.get("wire_rate", 0) // 1_000_000
         if counters:
             row["compression"] = round(counters["compression"], 2)
         rows.append(row)
-    for key in ("TPU_DIST_COMM_DTYPE", "TPU_DIST_ALGO", "TPU_DIST_SHM"):
+    for key in ("TPU_DIST_COMM_DTYPE", "TPU_DIST_ALGO", "TPU_DIST_SHM",
+                "TPU_DIST_FRAME_CRC"):
         os.environ.pop(key, None)
+    from tpu_dist.resilience import netchaos as _netchaos
+    _netchaos.uninstall()
     if rank == 0:
         with open(os.environ["BENCH_OUT"], "w") as f:
             json.dump(rows, f)
@@ -254,6 +280,19 @@ def _run_world(world: int, sizes, iters_override, check: bool,
                "iters": iters_override or _iters_for(nbytes, "dataplane")}
               for nbytes in sizes
               for algo in ("flat_shm", "hier")]
+    # frame-integrity (CRC) overhead isolate at the 8 MiB gate size: the
+    # SAME flat dataplane all-reduce with checksums armed (the default)
+    # vs disarmed, best-of-N max-MB/s each (the bench_obs_overhead
+    # anti-noise discipline), both arms paced to an identical emulated
+    # wire rate (netchaos slow-drip — see apply_case_env) so the gate
+    # measures integrity's cost in the wire-bound regime the data plane
+    # deploys into.  The crc_overhead summary is gated < 5% in the
+    # tier-1 --smoke run.
+    cases += [{"op": "all_reduce", "path": "dataplane", "bytes": 8 << 20,
+               "comm": None, "crc": c, "reps": 3,
+               "wire_rate": 150_000_000,
+               "iters": iters_override or 2}
+              for c in ("1", "0")]
     # simulated host layout (host-contiguous): world >= 4 splits into two
     # "hosts" (the 2-host x 2-rank acceptance layout at world 4); smaller
     # worlds co-locate on one, so SHM lanes exist at every world
@@ -331,9 +370,29 @@ def main(argv=None) -> int:
         all_rows.extend(rows)
 
     # the ISSUE 2 / ISSUE 8 / ISSUE 9 acceptance quantities, when measured
+    # (crc rows excluded: they share every other key field with the plain
+    # 8 MiB row and would silently replace it)
     by_key = {(r["op"], r["path"], r.get("comm", "f32"),
                r.get("algo", "flat"), r["world"], r["bytes"]): r["value"]
-              for r in all_rows}
+              for r in all_rows if r.get("crc") is None}
+    # ISSUE 13 gate: frame-checksum overhead at 8 MiB — armed (the
+    # production default) must cost < 5% effective MB/s vs disarmed
+    crc_vals = {(r["world"], r["crc"]): r["value"]
+                for r in all_rows if r.get("crc") is not None
+                and r["bytes"] == 8 << 20}
+    for world in worlds:
+        on = crc_vals.get((world, "1"))
+        off = crc_vals.get((world, "0"))
+        if on and off:
+            overhead = max(0.0, (off - on) / off * 100.0)
+            print(json.dumps({"metric": f"crc_overhead_8MiB_w{world}",
+                              "value": round(overhead, 2), "unit": "%",
+                              "threshold": 5.0}))
+            if args.smoke:
+                assert overhead < 5.0, (
+                    f"CRC frame-checksum overhead {overhead:.1f}% at "
+                    f"8 MiB world {world} exceeds the 5% gate "
+                    f"(armed {on} vs unarmed {off} MB/s)")
     ring = by_key.get(("all_reduce", "dataplane", "f32", "flat", 4,
                        8 << 20))
     store_v = by_key.get(("all_reduce", "store", "f32", "flat", 4,

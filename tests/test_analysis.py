@@ -269,6 +269,55 @@ def run(x, rank):
 """
 
 
+# broad except around a collective, neither re-raised nor logged: the
+# named fault diagnosis (PeerGoneError, FrameCorruptError, ...) is
+# swallowed and the injected fault turns back into a silent wrong result
+TD009_POS = """
+def sync(x, group):
+    try:
+        return C.all_reduce_host(x, group=group)
+    except Exception:
+        return x
+"""
+
+TD009_NEG = """
+def sync(x, group):
+    try:
+        return C.all_reduce_host(x, group=group)
+    except Exception as e:
+        log_event("grad-sync-failed", error=repr(e))
+        return x
+"""
+
+# catching the named class explicitly and swallowing it is the same bug
+TD009_NAMED_POS = """
+def fetch(dp, src):
+    try:
+        return dp.recv_array(src, "t", 5.0)
+    except PeerGoneError:
+        return None
+"""
+
+# re-raising (even wrapped) propagates the diagnosis: clean
+TD009_RERAISE_NEG = """
+def fetch(dp, src):
+    try:
+        return dp.recv_array(src, "t", 5.0)
+    except PeerGoneError as e:
+        raise RuntimeError(f"peer fetch failed: {e}") from e
+"""
+
+# a narrow handler around a non-collective body is none of TD009's
+# business — the rule keys on the named-error sources in the try body
+TD009_NARROW_NEG = """
+def load(path):
+    try:
+        return open(path).read()
+    except Exception:
+        return None
+"""
+
+
 class TestRules:
     @pytest.mark.parametrize("rule,pos,neg", [
         ("TD001", TD001_POS, TD001_NEG),
@@ -279,6 +328,7 @@ class TestRules:
         ("TD006", TD006_POS, TD006_NEG),
         ("TD007", TD007_POS, TD007_NEG),
         ("TD008", TD008_POS, TD008_NEG),
+        ("TD009", TD009_POS, TD009_NEG),
     ])
     def test_positive_flags_negative_passes(self, rule, pos, neg):
         assert rule in _rules(lint_source(pos, f"{rule}_pos.py")), \
@@ -328,9 +378,20 @@ class TestRules:
         assert "all_reduce_host" in f.message and "rank == 0" in f.message
         assert f.severity == "error"
 
+    def test_td009_explicit_named_catch_flags(self):
+        found = lint_source(TD009_NAMED_POS, "t.py")
+        assert _rules(found) == ["TD009"]
+        (f,) = found
+        assert f.severity == "error" and "PeerGoneError" in f.message
+
+    def test_td009_reraise_and_narrow_bodies_pass(self):
+        assert _rules(lint_source(TD009_RERAISE_NEG, "t.py")) == []
+        assert _rules(lint_source(TD009_NARROW_NEG, "t.py")) == []
+
     def test_rule_docs_cover_all_codes(self):
         assert sorted(RULE_DOCS) == ["TD001", "TD002", "TD003", "TD004",
-                                     "TD005", "TD006", "TD007", "TD008"]
+                                     "TD005", "TD006", "TD007", "TD008",
+                                     "TD009"]
 
     def test_td008_unguarded_group_collective_warns(self):
         found = lint_source(TD008_UNGUARDED_POS, "t.py")

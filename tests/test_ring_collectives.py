@@ -407,7 +407,14 @@ def test_bench_host_collectives_smoke():
         cwd=_REPO, env=env, capture_output=True, text=True, timeout=240)
     assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
     rows = [json.loads(line) for line in r.stdout.strip().splitlines()]
-    by_path = {(row["op"], row["path"]): row["value"] for row in rows}
+    by_path = {(row["op"], row["path"]): row["value"] for row in rows
+               if row.get("metric") == "host_collective"}
     for op in ("all_reduce", "all_gather", "broadcast"):
         assert by_path[(op, "dataplane")] > 0
         assert by_path[(op, "store")] > 0
+    # ISSUE 13 gate: frame-checksum overhead at 8 MiB on the emulated
+    # wire-bound link (both arms identically paced) stays under 5%
+    crc = [row for row in rows
+           if str(row.get("metric", "")).startswith("crc_overhead")]
+    assert crc, "bench smoke emitted no crc_overhead summary"
+    assert crc[0]["value"] < crc[0]["threshold"], crc

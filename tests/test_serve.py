@@ -406,6 +406,175 @@ class TestSocketLayer:
         cli.close()
 
 
+class TestCancellationAndDeadlines:
+    """ISSUE 13 serve degradation: per-request deadlines + mid-decode
+    cancellation (closes PR 10's 'no mid-decode cancellation' limit)."""
+
+    def test_cancel_frees_slot_at_next_iteration_boundary(self, lm):
+        model, params = lm
+        engine = serve.SlotEngine(model, params, num_slots=2)
+        errs = []
+        r = serve.Request(np.arange(4, dtype=np.int32), 30,
+                          on_error=lambda q, e: errs.append(e))
+        engine.admit(r)
+        engine.step()
+        assert engine.active_count() == 1
+        r.cancel()
+        assert engine.sweep_expired() == 1
+        assert engine.idle() and engine.free_slots() == 2
+        assert isinstance(errs[0], serve.RequestCancelledError)
+
+    def test_deadline_frees_slot_mid_decode(self, lm):
+        model, params = lm
+        engine = serve.SlotEngine(model, params, num_slots=2)
+        errs = []
+        r = serve.Request(np.arange(4, dtype=np.int32), 30,
+                          deadline_ms=30,
+                          on_error=lambda q, e: errs.append(e))
+        engine.admit(r)
+        engine.step()
+        time.sleep(0.05)  # past the 30 ms budget
+        assert engine.sweep_expired() == 1
+        assert engine.idle()
+        assert isinstance(errs[0], serve.DeadlineExceededError)
+
+    def test_expired_request_is_shed_before_admission(self, lm):
+        model, params = lm
+        engine = serve.SlotEngine(model, params, num_slots=2)
+        r = serve.Request(np.arange(4, dtype=np.int32), 4, deadline_ms=1)
+        time.sleep(0.01)
+        with pytest.raises(serve.DeadlineExceededError):
+            engine.admit(r)
+        assert engine.idle()  # no slot was spent on the stale request
+
+    def test_scheduler_handle_cancel_terminates_by_name(self, lm):
+        model, params = lm
+        engine = serve.SlotEngine(model, params, num_slots=2)
+        with serve.Scheduler(engine, batch_window=0.0) as sched:
+            h = sched.submit(list(range(4)), max_new_tokens=50)
+            # wait for the first token so the cancel lands MID-decode
+            for _ in h.iter_tokens(timeout=30.0):
+                break
+            h.cancel()
+            with pytest.raises(serve.RequestCancelledError):
+                h.wait_done(10.0)
+            deadline = time.monotonic() + 10.0
+            while not engine.idle() and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert engine.idle()  # the slot freed at a boundary, not at
+            # max_new_tokens
+
+    def test_client_disconnect_cancels_and_span_closes_cancelled(
+            self, lm, monkeypatch):
+        from tpu_dist.obs import recorder as rec_mod
+        model, params = lm
+        monkeypatch.setenv("TPU_DIST_OBS", "1")
+        rec_mod.reset()
+        engine = serve.SlotEngine(model, params, num_slots=2)
+        sched = serve.Scheduler(engine, batch_window=0.0)
+        fe = serve.Frontend(sched, port=0)
+        try:
+            cli = serve.ServeClient("127.0.0.1", fe.port, connect_retry=10)
+            h = cli.submit(list(range(4)), max_new_tokens=50)
+            for _ in h.iter_tokens(timeout=30.0):
+                break             # at least one token decoded
+            cli.close()           # client vanishes mid-decode
+            deadline = time.monotonic() + 10.0
+            while not engine.idle() and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert engine.idle(), "slot not freed after client disconnect"
+            assert engine.completed == 0  # cancelled, not decoded to 50
+            rec = rec_mod.get_recorder()
+            spans = [e for e in rec.snapshot()
+                     if e.get("kind") == "serve"]
+            assert spans and spans[-1]["outcome"] == "error:Cancelled"
+        finally:
+            fe.close()
+            sched.close()
+            rec_mod.reset()
+
+    def test_deadline_ms_over_the_wire_names_the_error(self, lm):
+        model, params = lm
+        engine = serve.SlotEngine(model, params, num_slots=2)
+        sched = serve.Scheduler(engine, batch_window=0.0)
+        fe = serve.Frontend(sched, port=0)
+        try:
+            cli = serve.ServeClient("127.0.0.1", fe.port, connect_retry=10)
+            h = cli.submit(list(range(4)), max_new_tokens=50,
+                           deadline_ms=25)
+            with pytest.raises(serve.RequestFailedError) as ei:
+                h.wait_done(30.0)
+            assert ei.value.error == "DeadlineExceededError"
+            cli.close()
+        finally:
+            fe.close()
+            sched.close()
+
+    def test_explicit_cancel_frame_over_the_wire(self, lm):
+        model, params = lm
+        engine = serve.SlotEngine(model, params, num_slots=2)
+        sched = serve.Scheduler(engine, batch_window=0.0)
+        fe = serve.Frontend(sched, port=0)
+        try:
+            cli = serve.ServeClient("127.0.0.1", fe.port, connect_retry=10)
+            h = cli.submit(list(range(4)), max_new_tokens=55)
+            h.cancel()  # sends the cancel frame
+            with pytest.raises(serve.RequestFailedError) as ei:
+                h.wait_done(30.0)
+            assert ei.value.error == "RequestCancelledError"
+            cli.close()
+        finally:
+            fe.close()
+            sched.close()
+
+
+@pytest.mark.netchaos
+class TestServeNetchaos:
+    """Serve-wire cells of the ISSUE 13 chaos matrix that need the full
+    stack (frame-level cells live in tests/test_netchaos.py)."""
+
+    def test_corrupt_submit_fails_bounded_and_named(self, lm):
+        from tpu_dist.resilience import netchaos
+        model, params = lm
+        engine = serve.SlotEngine(model, params, num_slots=2)
+        sched = serve.Scheduler(engine, batch_window=0.0)
+        fe = serve.Frontend(sched, port=0)
+        try:
+            cli = serve.ServeClient("127.0.0.1", fe.port, connect_retry=10)
+            netchaos.install("corrupt:surface=serve,frame=1")
+            h = cli.submit(list(range(4)), max_new_tokens=4)
+            # the server's framing layer rejects the corrupt frame
+            # (FrameCorruptError) and drops the connection; the client's
+            # no-silent-drop contract converts that into a named terminal
+            # error on the handle — bounded, never a hang
+            with pytest.raises((serve.ServerGoneError,
+                                serve.RequestFailedError)):
+                h.wait_done(15.0)
+        finally:
+            netchaos.uninstall()
+            fe.close()
+            sched.close()
+
+    def test_delayed_wire_still_completes(self, lm):
+        from tpu_dist.resilience import netchaos
+        model, params = lm
+        engine = serve.SlotEngine(model, params, num_slots=2)
+        sched = serve.Scheduler(engine, batch_window=0.0)
+        fe = serve.Frontend(sched, port=0)
+        try:
+            cli = serve.ServeClient("127.0.0.1", fe.port, connect_retry=10)
+            netchaos.install("delay:surface=serve,delay=0.002")
+            prompt = np.arange(5, dtype=np.int32)
+            got = cli.generate(prompt.tolist(), max_new_tokens=4,
+                               timeout=60.0)
+            assert got == _gen_ref(model, params, prompt, 4)
+            cli.close()
+        finally:
+            netchaos.uninstall()
+            fe.close()
+            sched.close()
+
+
 class TestObsIntegration:
     def test_request_span_fields_and_diagnose(self, lm, monkeypatch,
                                               tmp_path):

@@ -33,6 +33,12 @@ deadlocking example per rule):
   sub-group with no rank/membership guard (non-member ranks reach the
   call and die on ``GroupMembershipError`` — or deadlock the members if
   only some ranks guard).
+- **TD009** — broad (``except Exception`` / bare) or explicit handler
+  swallowing a *named* tpu_dist error class (``PeerGoneError``,
+  ``RankLostError``, ``CollectiveMismatchError``, ``FrameCorruptError``,
+  ``CollectiveTimeoutError``) without re-raising or logging: the
+  anti-pattern that turns the resilience layer's named diagnoses — and
+  every injected netchaos fault — back into silent hangs.
 - **TD007** — async collective ``Work`` handle dropped without ``wait()``:
   a bare-expression call with ``async_op=True`` (the handle is discarded
   on the spot), or a handle assigned to a name that is never used again.
@@ -780,6 +786,94 @@ def rule_td008(tree: ast.AST, path: str) -> List[Finding]:
     return out
 
 
+# -- TD009: broad except swallowing named tpu_dist error classes --------------
+#
+# The resilience/netchaos layers spend a lot of machinery converting hangs
+# and silent corruption into NAMED errors (PeerGoneError, RankLostError,
+# CollectiveMismatchError, FrameCorruptError, CollectiveTimeoutError).  A
+# `try: all_reduce_host(...)\nexcept Exception: pass` converts them right
+# back into silent wrong-results/hangs — the diagnosis is swallowed, the
+# peers keep waiting.  The rule fires on (a) a broad handler (bare,
+# Exception, BaseException) whose try body issues calls that raise the
+# named classes, and (b) an explicit catch of a named class — in either
+# case only when the handler neither re-raises nor records the error
+# (log_event / logger methods / a request-failing callback).
+
+_TD009_NAMED_ERRORS = frozenset({
+    "PeerGoneError", "RankLostError", "CollectiveMismatchError",
+    "FrameCorruptError", "CollectiveTimeoutError",
+})
+_TD009_BROAD = frozenset({"Exception", "BaseException"})
+# calls whose failure modes are exactly the named error classes
+_TD009_SOURCES = COLLECTIVE_CALLS | frozenset({
+    "send", "recv", "recv_array", "recv_array_dual", "send_array",
+    "send_quant", "wait_done", "wait_all",
+})
+# handler calls that count as propagating/recording the diagnosis
+_TD009_SINKS = frozenset({
+    "log_event", "warning", "error", "exception", "critical", "warn",
+    "fail", "fail_slot", "fail_all", "safe_record",
+})
+
+
+def _handler_caught(htype: ast.AST):
+    """Names a handler catches: set of identifiers, or None for bare."""
+    if htype is None:
+        return None
+    nodes = htype.elts if isinstance(htype, ast.Tuple) else [htype]
+    names = set()
+    for n in nodes:
+        name = _terminal_name(n)
+        if name:
+            names.add(name)
+    return names
+
+
+def _handler_propagates(handler: ast.ExceptHandler) -> bool:
+    for n in ast.walk(handler):
+        if isinstance(n, ast.Raise):
+            return True
+        if isinstance(n, ast.Call) and _terminal_name(n.func) in _TD009_SINKS:
+            return True
+    return False
+
+
+def rule_td009(tree: ast.AST, path: str) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Try):
+            continue
+        try_calls = set()
+        for stmt in node.body:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Call):
+                    name = _terminal_name(sub.func)
+                    if name in _TD009_SOURCES:
+                        try_calls.add(name)
+        for handler in node.handlers:
+            caught = _handler_caught(handler.type)
+            named = (caught or set()) & _TD009_NAMED_ERRORS
+            broad = caught is None or bool(caught & _TD009_BROAD)
+            if not (named or (broad and try_calls)):
+                continue
+            if _handler_propagates(handler):
+                continue
+            what = (f"named error class(es) {sorted(named)}" if named
+                    else f"errors from {sorted(try_calls)} (PeerGoneError, "
+                         f"FrameCorruptError, CollectiveTimeoutError, ...)")
+            shape = ("bare except" if caught is None
+                     else f"except {'/'.join(sorted(caught))}")
+            out.append(Finding(
+                "TD009", "error", path, handler.lineno, handler.col_offset,
+                f"{shape} swallows {what} without re-raising or logging: "
+                f"the named diagnosis the resilience layer produced is "
+                f"discarded, turning an injected/real network fault back "
+                f"into a silent hang or wrong result — re-raise, "
+                f"log_event(...), or fail the owning request by name"))
+    out.sort(key=lambda f: (f.line, f.col))
+    return out
+
+
 # -- registry -----------------------------------------------------------------
 
 RULES = {
@@ -790,6 +884,7 @@ RULES = {
     "TD006": rule_td006,
     "TD007": rule_td007,
     "TD008": rule_td008,
+    "TD009": rule_td009,
 }
 
 RULE_DOCS = {
@@ -806,6 +901,9 @@ RULE_DOCS = {
     "TD008": "sub-group built from a rank-divergent member list, or a "
              "collective issued on a group the caller may not be a "
              "member of",
+    "TD009": "broad/bare except swallowing a named tpu_dist error class "
+             "(PeerGoneError, RankLostError, CollectiveMismatchError, "
+             "FrameCorruptError) without re-raising or logging",
 }
 
 

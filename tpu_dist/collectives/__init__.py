@@ -41,7 +41,8 @@ from .eager import (ReduceOp, all_gather_host, all_gather_object,
 # (module-qualified — ``ring.ring_all_reduce`` is the host-payload twin of
 # the in-jit ``ops.ring_all_reduce`` above)
 from . import ring, transport
-from .transport import DataPlane, PeerGoneError
+from .transport import (CollectiveTimeoutError, DataPlane,
+                        FrameCorruptError, PeerGoneError)
 # async engine: Work futures (async_op=True), the ordered executor, and the
 # gradient bucketer (DDP Reducer / Horovod tensor-fusion parity)
 from . import bucketer, work
@@ -70,6 +71,7 @@ __all__ = [
     "all_gather_object", "gather_object", "broadcast_object_list",
     "scatter_object_list", "all_to_all_host",
     "ring", "transport", "DataPlane", "PeerGoneError",
+    "FrameCorruptError", "CollectiveTimeoutError",
     "work", "Work", "wait_all", "bucketer", "Bucketer", "BucketWork",
     "bucketed_all_reduce", "bucketed_reduce_scatter",
     "quant", "QuantScheme", "ErrorFeedback",

@@ -51,6 +51,7 @@ from __future__ import annotations
 import io
 import os
 import pickle
+import struct
 import time
 import weakref
 from typing import Any, List, Optional
@@ -416,15 +417,16 @@ def _routed_broadcast(x, group, store, src):
         t0 = time.perf_counter()
         key = f"{base}/sm"
         if me == src:
-            store.set(key, pickle.dumps([arrs[i] for i in small],
-                                        protocol=pickle.HIGHEST_PROTOCOL))
+            store.set(key,
+                      _seal(pickle.dumps([arrs[i] for i in small],
+                                         protocol=pickle.HIGHEST_PROTOCOL)))
             # copy: non-src ranks get fresh arrays off the wire, so src must
             # not hand back aliases of the caller's input (mutating the
             # result would silently diverge src from its peers)
             vals = [np.array(arrs[i]) for i in small]
         else:
             _wait_peer_keys(store, [key])  # bounded: src may have died
-            vals = pickle.loads(store.get(key))
+            vals = pickle.loads(_unseal(store.get(key), "store-broadcast"))
         if me != src and store.add(f"{key}/ack", 1) >= n - 1:
             store.delete_key(key)
             store.delete_key(f"{key}/ack")
@@ -508,15 +510,44 @@ def _coll_key(op: str, root: int, seq: int, peer: int, group=None) -> str:
     return f"{_ns()}{_group_scope(group)}/coll/{op}/{root}/{seq}/{peer}"
 
 
+# sealed store payloads: the data plane's frame checksums
+# (TPU_DIST_FRAME_CRC, transport.py) applied to pickled collective
+# payloads riding the control-plane store — a bit flipped in transit (or a
+# netchaos `corrupt` fault on the store surface) fails loudly with a named
+# FrameCorruptError at the consumer instead of deserializing to silently
+# wrong values.  The magic prefix cannot collide with pickle (protocol 2+
+# starts with b"\x80"), so sealed and unsealed peers interoperate.
+_SEAL_MAGIC = b"TPCK"
+
+
+def _seal(raw: bytes) -> bytes:
+    from .transport import frame_checksum, frame_crc_enabled
+    if not frame_crc_enabled():
+        return raw
+    return _SEAL_MAGIC + struct.pack("<I", frame_checksum((raw,))) + raw
+
+
+def _unseal(raw: bytes, what: str) -> bytes:
+    if raw[:4] != _SEAL_MAGIC:
+        return raw  # posted by a checksum-disabled peer: deliver as-is
+    from .transport import FrameCorruptError, frame_checksum
+    (expected,) = struct.unpack("<I", raw[4:8])
+    body = raw[8:]
+    got = frame_checksum((body,))
+    if got != expected:
+        raise FrameCorruptError(None, what, len(body), expected, got, 0)
+    return body
+
+
 def _tree_to_bytes(tree) -> bytes:
     # HIGHEST_PROTOCOL: protocol 5 frames large buffers out-of-band
     # (PEP 574), skipping one full copy of every array on the wire
-    return pickle.dumps(jax.tree.map(np.asarray, tree),
-                        protocol=pickle.HIGHEST_PROTOCOL)
+    return _seal(pickle.dumps(jax.tree.map(np.asarray, tree),
+                              protocol=pickle.HIGHEST_PROTOCOL))
 
 
 def _tree_from_bytes(raw: bytes):
-    return pickle.loads(raw)
+    return pickle.loads(_unseal(raw, "store-tree"))
 
 
 # -- data-plane routing -------------------------------------------------------
@@ -716,8 +747,24 @@ def _next_seq(op: str, root: int) -> int:
 def _wait_peer_keys(store, keys) -> None:
     """Bounded wait for peer-posted store keys: a peer that died mid-step
     must surface as a named timeout (same deadline knob as the data plane),
-    not an infinite poll the supervisor has to break from outside."""
-    from .transport import _default_timeout
+    not an infinite poll the supervisor has to break from outside.  When
+    the collective watchdog is armed (``TPU_DIST_COLL_TIMEOUT``) it
+    governs here too, so a store-path collective wedged by a dead/
+    partitioned peer raises the same named
+    :class:`~tpu_dist.collectives.transport.CollectiveTimeoutError` the
+    ring path does."""
+    from .transport import (CollectiveTimeoutError, _default_timeout,
+                            coll_timeout)
+    ct = coll_timeout()
+    if ct > 0:
+        try:
+            store.wait(keys, timeout=ct)
+        except TimeoutError as e:
+            raise CollectiveTimeoutError(
+                f"store collective wedged: peer key never posted within "
+                f"TPU_DIST_COLL_TIMEOUT={ct:.0f}s — a peer is dead or "
+                f"partitioned: {e}") from e
+        return
     timeout = _default_timeout()
     try:
         store.wait(keys, timeout=timeout if timeout > 0 else None)
@@ -737,13 +784,15 @@ def _store_all_gather_payload(payload, group, store, base: str) -> dict:
     whose ack hits world-1) deletes the data and ack keys, so per-call keys
     never accumulate in the server."""
     n, me = group.num_processes, group.rank
-    store.set(f"{base}/{me}", pickle.dumps(payload,
-                                           protocol=pickle.HIGHEST_PROTOCOL))
+    store.set(f"{base}/{me}",
+              _seal(pickle.dumps(payload,
+                                 protocol=pickle.HIGHEST_PROTOCOL)))
     peers = [r for r in range(n) if r != me]
     _wait_peer_keys(store, [f"{base}/{r}" for r in peers])
     rows = {me: payload}
     for r in peers:
-        rows[r] = pickle.loads(store.get(f"{base}/{r}"))
+        rows[r] = pickle.loads(_unseal(store.get(f"{base}/{r}"),
+                                       "store-allgather"))
         if store.add(f"{base}/{r}/ack", 1) >= n - 1:
             store.delete_key(f"{base}/{r}")
             store.delete_key(f"{base}/{r}/ack")

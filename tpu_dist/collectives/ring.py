@@ -59,11 +59,13 @@ paths.
 from __future__ import annotations
 
 import os
+import time
 from typing import Optional, Tuple
 
 import numpy as np
 
 from . import quant as _Q
+from .transport import CollectiveTimeoutError, coll_timeout
 
 __all__ = ["ring_all_reduce", "ring_all_gather", "ring_reduce_scatter",
            "ring_chunk_all_gather", "tree_broadcast", "ring_chunk_span",
@@ -114,6 +116,66 @@ def ring_chunk_span(n_elems: int, n: int, rank: int) -> Tuple[int, int]:
     """The (lo, hi) flat span of ``rank``'s chunk in a ring reduce-scatter
     over ``n_elems`` elements."""
     return _bounds(n_elems, n)[rank]
+
+
+def _obs_position() -> str:
+    """This rank's last flight-recorder position (armed runs) — stamped
+    into watchdog errors so the diagnosis names where the collective stood
+    when it wedged, not just that it did."""
+    try:
+        from ..obs import hooks as _hooks
+        from ..obs import recorder as _rec
+        rec = _rec.get_recorder()
+        if rec is not None:
+            pos = rec.last_position()
+            if pos is not None:
+                return f"; flight recorder: {_hooks.render_tail(pos)}"
+    except Exception:
+        pass
+    return ""
+
+
+class _Watchdog:
+    """End-to-end deadline for ONE collective (``TPU_DIST_COLL_TIMEOUT``).
+
+    Every blocking recv in the ring charges against the same budget, so a
+    partitioned/wedged hop raises :class:`CollectiveTimeoutError` naming
+    the stalled hop within the configured bound — instead of each frame
+    independently waiting out the (much longer) per-frame
+    ``TPU_DIST_DP_TIMEOUT``.  Disabled (budget 0) it delegates to the
+    transport's own internal deadline, exactly the old behavior."""
+
+    __slots__ = ("op", "budget", "deadline")
+
+    def __init__(self, op: str):
+        self.op = op
+        self.budget = coll_timeout()
+        self.deadline = (time.monotonic() + self.budget
+                         if self.budget > 0 else None)
+
+    def recv(self, dp, src: int, tag: str, pos: int, hi: int):
+        """One blocking frame recv under the collective deadline."""
+        if self.deadline is None:
+            # tpudlint: disable=TD004  # recv_array applies TPU_DIST_DP_TIMEOUT
+            return dp.recv_array(src, tag)
+        left = self.deadline - time.monotonic()
+        if left <= 0:
+            self._expired(dp, src, tag, pos, hi, None)
+        try:
+            return dp.recv_array(src, tag, timeout=left)
+        except CollectiveTimeoutError:
+            raise
+        except TimeoutError as e:
+            self._expired(dp, src, tag, pos, hi, e)
+
+    def _expired(self, dp, src: int, tag: str, pos: int, hi: int,
+                 cause) -> None:
+        raise CollectiveTimeoutError(
+            f"collective {self.op} wedged: rank {dp.rank} got no frame "
+            f"from rank {src} (tag {tag!r}, waiting for span "
+            f"[{pos}:{hi})) within TPU_DIST_COLL_TIMEOUT="
+            f"{self.budget:.0f}s — stalled hop {src}->{dp.rank}"
+            f"{_obs_position()}") from cause
 
 
 def _combine(op: str):
@@ -225,19 +287,22 @@ def _fold(flat: np.ndarray, seg, pos: int, hi: int, tag: str,
 
 
 def _recv_span(dp, src: int, tag: str, flat: np.ndarray, lo: int, hi: int,
-               combine=None) -> None:
+               combine=None, wd: Optional[_Watchdog] = None) -> None:
     """Receive sub-chunk frames into flat[lo:hi]; each arriving frame is
     processed while the transport thread keeps reading the next one off
     the wire."""
+    if wd is None:
+        wd = _Watchdog("recv_span")
     pos = lo
     while pos < hi:
-        # tpudlint: disable=TD004  # recv_array applies TPU_DIST_DP_TIMEOUT
-        pos = _fold(flat, dp.recv_array(src, tag), pos, hi, tag, combine)
+        pos = _fold(flat, wd.recv(dp, src, tag, pos, hi), pos, hi, tag,
+                    combine)
 
 
 def _exchange(dp, right: int, left: int, tag: str, flat: np.ndarray,
               send_lo: int, send_hi: int, recv_lo: int, recv_hi: int,
-              combine, wire_dtype, residual=None) -> int:
+              combine, wire_dtype, residual=None,
+              wd: Optional[_Watchdog] = None) -> int:
     """One double-buffered ring step: send ``flat[send_lo:send_hi]`` to
     ``right`` as sub-chunk frames while folding the frames arriving from
     ``left`` into ``flat[recv_lo:recv_hi]``.  Returns wire bytes sent.
@@ -285,10 +350,11 @@ def _exchange(dp, right: int, left: int, tag: str, flat: np.ndarray,
             if got is None:
                 break
             rp = _fold(flat, got, rp, recv_hi, tag, combine)
+    if wd is None:
+        wd = _Watchdog("exchange")
     while rp < recv_hi:
-        # tpudlint: disable=TD004  # recv_array applies TPU_DIST_DP_TIMEOUT
-        rp = _fold(flat, dp.recv_array(left, tag), rp, recv_hi, tag,
-                   combine)
+        rp = _fold(flat, wd.recv(dp, left, tag, rp, recv_hi), rp, recv_hi,
+                   tag, combine)
     return wb
 
 
@@ -311,7 +377,7 @@ def _prepare(dp, x, op: str):
 
 
 def _reduce_scatter_phase(dp, flat, bounds, n, r, op, tag,
-                          wire_dtype, residual=None) -> int:
+                          wire_dtype, residual=None, wd=None) -> int:
     """N-1 double-buffered ring steps; afterwards this rank's own chunk
     ``bounds[r]`` holds the full reduction.  Schedule is the textbook one
     shifted so rank r ends up owning chunk r (send chunk (r-1-step),
@@ -329,11 +395,12 @@ def _reduce_scatter_phase(dp, flat, bounds, n, r, op, tag,
         ri = (rp - step - 1) % n
         wb += _exchange(dp, right, left, tag, flat, *bounds[si],
                         *bounds[ri], combine=comb, wire_dtype=wire_dtype,
-                        residual=residual)
+                        residual=residual, wd=wd)
     return wb
 
 
-def _all_gather_phase(dp, flat, bounds, n, r, tag, wire_dtype) -> int:
+def _all_gather_phase(dp, flat, bounds, n, r, tag, wire_dtype,
+                      wd=None) -> int:
     """N-1 double-buffered ring steps circulating the fully-reduced chunks
     (rank r starts owning chunk r).  Returns wire bytes sent.  Quant
     schemes take :func:`_ag_phase_quant` instead (verbatim frame
@@ -344,7 +411,8 @@ def _all_gather_phase(dp, flat, bounds, n, r, tag, wire_dtype) -> int:
         si = (r - step) % n
         ri = (r - step - 1) % n
         wb += _exchange(dp, right, left, tag, flat, *bounds[si],
-                        *bounds[ri], combine=None, wire_dtype=wire_dtype)
+                        *bounds[ri], combine=None, wire_dtype=wire_dtype,
+                        wd=wd)
     return wb
 
 
@@ -423,13 +491,15 @@ def _land_quant(flat, got, pos: int, hi: int, tag: str, incoming) -> int:
 
 
 def _ag_phase_quant(dp, flat, bounds, n, r, tag, scheme,
-                    residual=None) -> int:
+                    residual=None, wd=None) -> int:
     """All-gather phase under a quant scheme: the owner compresses its
     chunk ONCE (folding in the error-feedback residual, replacing its own
     span with the dequantized values every peer will hold), then the
     quantized frames circulate **verbatim** — each rank forwards exactly
     the bytes it received, so all N ranks reconstruct every chunk from
     identical frames.  Returns wire bytes sent."""
+    if wd is None:
+        wd = _Watchdog("ag_phase_quant")
     right, left = (r + 1) % n, (r - 1) % n
     lo, hi = bounds[r]
     chunk = np.array(flat[lo:hi])  # standalone: _compress_owned re-binds
@@ -450,9 +520,8 @@ def _ag_phase_quant(dp, flat, bounds, n, r, tag, scheme,
                     break
                 pos = _land_quant(flat, got, pos, rhi, tag, incoming)
         while pos < rhi:
-            # tpudlint: disable=TD004  # recv_array applies TPU_DIST_DP_TIMEOUT
-            pos = _land_quant(flat, dp.recv_array(left, tag), pos, rhi,
-                              tag, incoming)
+            pos = _land_quant(flat, wd.recv(dp, left, tag, pos, rhi), pos,
+                              rhi, tag, incoming)
         frames = incoming
     return wb
 
@@ -514,9 +583,10 @@ def ring_all_reduce(dp, x, op: str = "sum", tag: str = "ar",
     res_full, res_own = _split_residual(quant_residual, wire, flat.size,
                                         bounds[r])
     utag = f"{tag}/rar"
+    wd = _Watchdog(f"ring_all_reduce[{op}]")
     with _obs_span("ring_all_reduce", x):
         wb = _reduce_scatter_phase(dp, flat, bounds, n, r, op, utag, wire,
-                                   residual=res_full)
+                                   residual=res_full, wd=wd)
         lo, hi = bounds[r]
         if op in ("avg", "mean"):
             flat[lo:hi] = flat[lo:hi] / n
@@ -524,7 +594,7 @@ def ring_all_reduce(dp, x, op: str = "sum", tag: str = "ar",
             # owner compression + verbatim frame circulation (quant.py's
             # byte-identity discipline)
             wb += _ag_phase_quant(dp, flat, bounds, n, r, utag, wire,
-                                  residual=res_own)
+                                  residual=res_own, wd=wd)
         else:
             if wire is not None:
                 # re-quantize the owned chunk through the wire dtype so
@@ -533,7 +603,8 @@ def ring_all_reduce(dp, x, op: str = "sum", tag: str = "ar",
                 deq, _ = _compress_owned(np.array(flat[lo:hi]), wire,
                                          res_own)
                 flat[lo:hi] = deq
-            wb += _all_gather_phase(dp, flat, bounds, n, r, utag, wire)
+            wb += _all_gather_phase(dp, flat, bounds, n, r, utag, wire,
+                                    wd=wd)
         # uncompressed-equivalent of the same traffic: this rank sends
         # every chunk but its own in the RS phase and every chunk but its
         # right neighbor's in the AG phase
@@ -608,7 +679,9 @@ def ring_reduce_scatter(dp, x, op: str = "sum", tag: str = "rs",
         with _obs_span("ring_reduce_scatter", x):
             wb = _reduce_scatter_phase(dp, flat, bounds, n, r,
                                        op, f"{tag}/rrs", wire,
-                                       residual=res_full)
+                                       residual=res_full,
+                                       wd=_Watchdog(
+                                           f"ring_reduce_scatter[{op}]"))
             _note_stats(stats, wire, wb,
                         (flat.size - _span_len(bounds, r)) * flat.itemsize)
     lo, hi = bounds[r]
@@ -653,17 +726,18 @@ def ring_chunk_all_gather(dp, flat, bounds, tag: str = "cag",
         return flat
     bounds = _check_bounds(bounds, n, flat.size)
     wire = _resolve_wire(comm_dtype, flat.dtype, float_only=True)
+    wd = _Watchdog("ring_chunk_all_gather")
     with _obs_span("ring_chunk_all_gather", flat):
         if isinstance(wire, _Q.QuantScheme):
             wb = _ag_phase_quant(dp, flat, bounds, n, r, f"{tag}/rcag",
-                                 wire)
+                                 wire, wd=wd)
         else:
             if wire is not None:
                 lo, hi = bounds[r]
                 deq, _ = _compress_owned(np.array(flat[lo:hi]), wire, None)
                 flat[lo:hi] = deq
             wb = _all_gather_phase(dp, flat, bounds, n, r, f"{tag}/rcag",
-                                   wire_dtype=wire)
+                                   wire_dtype=wire, wd=wd)
         _note_stats(stats, wire, wb,
                     (flat.size - _span_len(bounds, (r + 1) % n))
                     * flat.itemsize)
@@ -694,18 +768,19 @@ def ring_all_gather(dp, x, tag: str = "ag", comm_dtype=None,
     sz = flat.size
     bounds = [(i * sz, (i + 1) * sz) for i in range(n)]
     wire = _resolve_wire(comm_dtype, out.dtype, float_only=True)
+    wd = _Watchdog("ring_all_gather")
     with _obs_span("ring_all_gather", x):
         wb = 0
         if sz:
             if isinstance(wire, _Q.QuantScheme):
                 wb = _ag_phase_quant(dp, out_flat, bounds, n, r, utag,
-                                     wire)
+                                     wire, wd=wd)
             else:
                 if wire is not None:
                     deq, _ = _compress_owned(np.array(out[r]), wire, None)
                     out[r] = deq
                 wb = _all_gather_phase(dp, out_flat, bounds, n, r, utag,
-                                       wire_dtype=wire)
+                                       wire_dtype=wire, wd=wd)
         _note_stats(stats, wire, wb, sz * (n - 1) * out.itemsize)
     return out.reshape((n,) + x.shape)
 
@@ -728,6 +803,7 @@ def tree_broadcast(dp, x, src: int = 0, tag: str = "bc") -> np.ndarray:
         flat = np.empty(x.size, dtype=x.dtype)
     utag = f"{tag}/tbc"
     k = 1
+    wd = _Watchdog("tree_broadcast")
     with _obs_span("tree_broadcast", x):
         while k < n:
             if rel < k:
@@ -737,6 +813,6 @@ def tree_broadcast(dp, x, src: int = 0, tag: str = "bc") -> np.ndarray:
                                flat.size, wire_dtype=None)
             elif rel < 2 * k:
                 _recv_span(dp, (src + rel - k) % n, utag, flat, 0,
-                           flat.size, combine=None)
+                           flat.size, combine=None, wd=wd)
             k *= 2
     return flat.reshape(x.shape)

@@ -34,6 +34,17 @@ Deliberately a *payload* lane, not a second transport:
   on x86 (total store order); the same discipline every mmap'd SPSC queue
   relies on.
 
+- **Mid-stream failure degrades, pre-header.**  The transport stages a
+  frame's payload into the lane *before* committing the frame header to
+  TCP, so any lane failure at staging time (mapping gone, injected
+  ``TPU_DIST_NETCHAOS`` fault on the ``shm`` surface) lets the sender
+  fall back to an inline-TCP payload for that very frame — the
+  collective completes bitwise-equal over the degraded transport
+  (transport.py ``_lane_stage``/``_degrade_lane``).  Lane payloads are
+  covered by the same per-frame checksums as TCP payloads
+  (``TPU_DIST_FRAME_CRC``): the integrity word rides the TCP header
+  stream while the bytes move through shared memory.
+
 Env knobs: ``TPU_DIST_SHM`` (``auto`` default — lanes come up for
 co-located peers; ``0`` disables), ``TPU_DIST_SHM_RING`` (ring capacity
 bytes, default 8 MiB).  Lane names carry the gang generation and the

@@ -43,9 +43,27 @@ Design notes:
   a frame racing ahead of lane setup all ship inline, and the receiver
   accepts both forms at any time.
 
+- **Frame integrity (CRC).**  Every frame payload carries a 32-bit
+  checksum (``TPU_DIST_FRAME_CRC``, armed by default; ``0`` disables) —
+  CRC32C where a native implementation is importable, zlib CRC32
+  otherwise (same 4-byte integrity contract).  The sender marks the
+  frame's dtype name (``!`` prefix) and appends the checksum to the
+  header; the receiver verifies after the payload lands (inline TCP or
+  SHM lane alike) and raises a named :class:`FrameCorruptError` — src,
+  tag, stream offset, both CRCs — instead of folding flipped bits into
+  gradients.  The marker travels per frame, so a rank with checksums
+  disabled still interoperates: unmarked frames are simply not verified.
+
 Env knobs: ``TPU_DIST_DP_HOST`` (advertised address override),
 ``TPU_DIST_SHM`` / ``TPU_DIST_SHM_RING`` (shared-memory lanes, shm.py),
 ``TPU_DIST_DP_TIMEOUT`` (recv deadline, seconds, default 300),
+``TPU_DIST_COLL_TIMEOUT`` (end-to-end collective watchdog, seconds,
+0/unset = off — ring/eager collectives raise
+:class:`CollectiveTimeoutError` naming the stalled hop instead of waiting
+out the per-frame deadline), ``TPU_DIST_FRAME_CRC`` (payload checksums,
+default on), ``TPU_DIST_NETCHAOS`` (deterministic network fault
+injection, tpu_dist/resilience/netchaos.py — partitions, delays, resets,
+truncations, bit flips and throttles at this module's frame boundary),
 ``TPU_DIST_NO_DATAPLANE=1`` (disable; collectives fall back to the store),
 ``TPU_DIST_SOCK_BUF`` (bytes for ``SO_SNDBUF``/``SO_RCVBUF`` on every
 data-plane socket; 0/unset keeps the OS default — the negotiated sizes are
@@ -68,8 +86,9 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
-__all__ = ["DataPlane", "PeerGoneError", "get_data_plane",
-           "close_data_plane"]
+__all__ = ["DataPlane", "PeerGoneError", "FrameCorruptError",
+           "CollectiveTimeoutError", "get_data_plane", "close_data_plane",
+           "frame_crc_enabled", "frame_checksum", "coll_timeout"]
 
 _MAGIC = b"TPDP"
 _HELLO = struct.Struct("<4sII")      # magic, rank, generation
@@ -78,12 +97,27 @@ _HELLO = struct.Struct("<4sII")      # magic, rank, generation
 # User tags are store-key-shaped paths, so the NUL prefix cannot collide.
 _SHM_TAG = "\x00shm-lane"
 _SHM_MARK = "&"
+# dtype-name marker: a 4-byte payload checksum follows the frame header
+# (composable with the SHM mark, which stays outermost: "&!float32")
+_CRC_MARK = "!"
 _CONTROL = object()   # _read_frame sentinel: handled frame, nothing to queue
 _U8 = struct.Struct("<B")
 _U16 = struct.Struct("<H")
 _U32 = struct.Struct("<I")
 _U64 = struct.Struct("<Q")
 _CONNECT_TIMEOUT = 60.0
+
+
+def _connect_deadline() -> float:
+    """Overall budget for dialing a peer's listener
+    (``TPU_DIST_DIAL_TIMEOUT``, default 60 s) — individual dials retry
+    under exponential backoff inside it, so a peer mid-restart is a
+    transparent retry and a dead one a bounded named error."""
+    try:
+        return max(0.1, float(os.environ.get("TPU_DIST_DIAL_TIMEOUT",
+                                             str(_CONNECT_TIMEOUT))))
+    except ValueError:
+        return _CONNECT_TIMEOUT
 
 
 class PeerGoneError(ConnectionError):
@@ -98,6 +132,139 @@ class PeerGoneError(ConnectionError):
         if detail:
             msg += f": {detail}"
         super().__init__(msg)
+
+
+class FrameCorruptError(ConnectionError):
+    """A frame's payload failed its checksum: the bytes that arrived are
+    not the bytes that were sent.  Carries the source rank, the frame tag,
+    the stream offset (payload bytes previously delivered on this
+    connection) and both CRCs — the named alternative to silently folding
+    a flipped bit into gradients.  The connection is unusable afterwards
+    (stream integrity is lost), so the peer is marked gone and every
+    blocked recv re-raises this error."""
+
+    def __init__(self, peer: Optional[int], tag: str, nbytes: int,
+                 expected: int, got: int, offset: int):
+        self.peer = None if peer is None else int(peer)
+        self.tag = tag
+        self.nbytes = int(nbytes)
+        self.expected = int(expected)
+        self.got = int(got)
+        self.offset = int(offset)
+        src = ("the control-plane store" if peer is None
+               else f"rank {peer}")
+        super().__init__(
+            f"corrupt frame from {src} tag {tag!r}: payload checksum "
+            f"mismatch (expected {expected:#010x}, got {got:#010x}) over "
+            f"{nbytes} bytes at stream offset {offset} — refusing to "
+            f"deliver corrupt payload bytes")
+
+
+class CollectiveTimeoutError(TimeoutError):
+    """A host collective failed to complete within
+    ``TPU_DIST_COLL_TIMEOUT``: some hop of the ring/tree never delivered
+    (a network partition, a wedged peer).  The message names the stalled
+    hop (which peer, which span, which tag) and, when the flight recorder
+    is armed, this rank's last recorded position — the diagnosis a silent
+    hang never yields."""
+
+
+def coll_timeout() -> float:
+    """End-to-end collective watchdog budget in seconds
+    (``TPU_DIST_COLL_TIMEOUT``; 0/unset = disabled — each blocking recv
+    then falls back to the per-frame ``TPU_DIST_DP_TIMEOUT``)."""
+    try:
+        return max(0.0, float(os.environ.get("TPU_DIST_COLL_TIMEOUT",
+                                             "0") or 0))
+    except ValueError:
+        return 0.0
+
+
+# CRC32C when a native implementation is reachable — hardware (SSE4.2)
+# CRC32C runs ~20 GB/s, which is what keeps the armed-overhead gate < 5%
+# even on loopback where the "wire" moves at memory speed.  Resolution
+# order: (1) the raw C ``crc32c_extend`` from the library google_crc32c
+# ships, bound zero-copy through ctypes (the package's own Python entry
+# point only accepts ``bytes``, which would force a copy per frame);
+# (2) google_crc32c's Python API (bytes copy, still ~5 GB/s); (3) the
+# ``crc32c`` package; (4) zlib's CRC32 (~1 GB/s, different polynomial,
+# same 4-byte integrity contract).  The marker byte travels per frame, so
+# hosts resolving different implementations MUST NOT be mixed in one gang
+# — like every wire knob, TPU_DIST_FRAME_CRC is launcher-uniform and the
+# resolution is environment-deterministic.
+
+
+def _resolve_crc_fn():  # pragma: no cover - environment-dependent
+    try:
+        import ctypes
+        import glob
+
+        import google_crc32c
+        root = os.path.join(
+            os.path.dirname(os.path.dirname(google_crc32c.__file__)),
+            "google_crc32c.libs")
+        lib = ctypes.CDLL(glob.glob(os.path.join(root,
+                                                 "libcrc32c*.so*"))[0])
+        lib.crc32c_extend.restype = ctypes.c_uint32
+        lib.crc32c_extend.argtypes = [ctypes.c_uint32, ctypes.c_void_p,
+                                      ctypes.c_size_t]
+
+        def _crc_hw(data, crc=0):
+            a = np.frombuffer(data, np.uint8)  # zero-copy pointer access
+            return lib.crc32c_extend(crc, a.ctypes.data, a.size)
+
+        _crc_hw(b"tpu_dist")  # prove the binding before committing to it
+        return _crc_hw
+    except Exception:
+        pass
+    try:
+        from google_crc32c import extend as _gcrc
+
+        return lambda data, crc=0: _gcrc(crc, bytes(data))
+    except Exception:
+        pass
+    try:
+        from crc32c import crc32c
+
+        return crc32c
+    except Exception:
+        from zlib import crc32
+
+        return crc32
+
+
+_crc_fn = _resolve_crc_fn()
+
+
+def frame_checksum(parts, seed: int = 0) -> int:
+    """Streaming checksum over payload parts (in wire order)."""
+    c = seed
+    for p in parts:
+        v = memoryview(p).cast("B").toreadonly()
+        if len(v):
+            c = _crc_fn(v, c)
+    return c & 0xFFFFFFFF
+
+
+def frame_crc_enabled() -> bool:
+    """Whether outgoing frames carry payload checksums
+    (``TPU_DIST_FRAME_CRC``; armed by default).  Read per send, and
+    one-sided-safe: the receiver verifies exactly the frames that arrive
+    marked."""
+    return os.environ.get("TPU_DIST_FRAME_CRC", "auto").strip().lower() \
+        not in ("0", "off", "false", "no")
+
+
+def _net_chaos():
+    """The active network-fault injector, or None.  Guarded by
+    sys.modules + the env var, so a process that never arms netchaos
+    never imports it — the disarmed per-frame cost is two dict lookups."""
+    import sys
+    if "tpu_dist.resilience.netchaos" not in sys.modules \
+            and not os.environ.get("TPU_DIST_NETCHAOS"):
+        return None
+    from ..resilience import netchaos
+    return netchaos.install_from_env()
 
 
 def _default_timeout() -> float:
@@ -184,6 +351,53 @@ def _sendv(sock, header: bytes, *payloads) -> None:
             parts[0] = parts[0][n:]
 
 
+def _sendv_paced(sock, header: bytes, parts, rate: float) -> None:
+    """Throttled send (netchaos ``slow-drip``): the header goes out whole
+    (frame parsing must make progress), payload bytes drip at ``rate``
+    bytes/sec in ~10 ms slices.  Deterministic degradation, not an error:
+    the frame completes, just slowly — bounded by the caller's deadlines."""
+    sock.sendall(header)
+    rate = max(1.0, float(rate))
+    chunk = max(1, int(rate * 0.01))
+    for p in parts:
+        view = memoryview(p).cast("B")
+        for off in range(0, len(view), chunk):
+            piece = view[off:off + chunk]
+            sock.sendall(piece)
+            time.sleep(len(piece) / rate)
+
+
+def _inject_break(sock, header: bytes, parts, plan) -> None:
+    """netchaos ``conn-reset`` / ``truncate``: break the connection
+    mid-frame and raise — the sender's error path turns it into a named
+    ``PeerGoneError``; the receiver's framing layer sees a reset or a
+    truncated frame and marks the peer gone the same way."""
+    if plan.kind == "truncate":
+        # header promises the full payload; deliver half of the first
+        # part, then FIN — the receiver raises "connection closed
+        # mid-frame" / "truncated frame" at the exact byte boundary
+        sock.sendall(header)
+        first = memoryview(parts[0]).cast("B") if parts else b""
+        if len(first):
+            sock.sendall(first[:max(1, len(first) // 2)])
+        try:
+            sock.shutdown(socket.SHUT_WR)
+        except OSError:
+            pass
+    else:
+        # RST mid-header: SO_LINGER(0) close discards the send queue and
+        # resets — the hard variant (ECONNRESET on the peer)
+        try:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                            struct.pack("ii", 1, 0))
+        except OSError:
+            pass
+        sock.sendall(header[:max(1, len(header) // 2)])
+    sock.close()
+    raise ConnectionResetError(
+        f"netchaos: injected {plan.kind} mid-frame")
+
+
 def _recv_exact(conn, n: int) -> Optional[bytearray]:
     """Read exactly ``n`` bytes into a fresh (writable) buffer.
 
@@ -249,7 +463,12 @@ class DataPlane:
         self._cv = threading.Condition()
         self._in_q: Dict[Tuple[int, str], deque] = {}
         self._dead: Dict[int, str] = {}
+        # peer -> the exception that killed its connection, when it is a
+        # NAMED diagnosis (FrameCorruptError): blocked recvs re-raise the
+        # named class instead of a generic PeerGoneError
+        self._dead_errs: Dict[int, BaseException] = {}
         self._in_conn: Dict[int, object] = {}  # peer -> current inbound sock
+        self._rx_off: Dict[int, int] = {}      # id(conn) -> payload bytes in
 
         # outbound connections, one per destination, each with its own lock
         # so concurrent senders to different peers do not serialize
@@ -321,6 +540,7 @@ class DataPlane:
     def _reader(self, conn, bufs=(0, 0)):
         peer = None
         detail = "connection closed"
+        named_err = None
         try:
             hello = _recv_exact(conn, _HELLO.size)
             if hello is None:
@@ -339,6 +559,7 @@ class DataPlane:
                 # reconnected after a transient drop, so future recvs must
                 # wait for its frames again instead of failing spuriously
                 self._dead.pop(peer, None)
+                self._dead_errs.pop(peer, None)
                 self._in_conn[peer] = conn
             self._obs("peer-connect", peer, sndbuf=bufs[0], rcvbuf=bufs[1])
             while True:
@@ -353,11 +574,16 @@ class DataPlane:
                     self._cv.notify_all()
         except OSError as e:
             detail = repr(e)
+            if isinstance(e, FrameCorruptError):
+                # keep the NAMED diagnosis: blocked recvs re-raise exactly
+                # this (src/tag/offset) instead of a generic peer-gone
+                named_err = e
         finally:
             try:
                 conn.close()
             except OSError:
                 pass
+            self._rx_off.pop(id(conn), None)
             lane = self._shm_in.pop(id(conn), None)
             if lane is not None:
                 lane.close()  # this reader owned the mapping
@@ -370,6 +596,8 @@ class DataPlane:
                     if self._in_conn.get(peer) is conn:
                         del self._in_conn[peer]
                         self._dead[peer] = detail
+                        if named_err is not None:
+                            self._dead_errs[peer] = named_err
                         self._cv.notify_all()
                         died = True
                 if died:
@@ -381,7 +609,9 @@ class DataPlane:
                     if lane is not None:
                         lane.unlink()
                     self._obs("peer-gone", peer, detail=detail,
-                              outcome="error:PeerGone")
+                              outcome=("error:FrameCorrupt"
+                                       if named_err is not None
+                                       else "error:PeerGone"))
 
     def _read_frame(self, conn, peer):
         raw = _recv_exact(conn, _U32.size)
@@ -396,14 +626,31 @@ class DataPlane:
             _U64.unpack(bytes(_recv_exact_or_raise(conn, _U64.size)))[0]
             for _ in range(ndim))
         (plen,) = _U64.unpack(bytes(_recv_exact_or_raise(conn, _U64.size)))
-        if dtype_name.startswith(_SHM_MARK):
+        lane_mode = dtype_name.startswith(_SHM_MARK)
+        if lane_mode:
+            dtype_name = dtype_name[len(_SHM_MARK):]
+        crc_expected = None
+        if dtype_name.startswith(_CRC_MARK):
+            # a payload checksum follows the header (always on the TCP
+            # socket — in lane mode the payload bytes are in shared
+            # memory, but the integrity word rides the ordered stream)
+            dtype_name = dtype_name[len(_CRC_MARK):]
+            (crc_expected,) = _U32.unpack(
+                bytes(_recv_exact_or_raise(conn, _U32.size)))
+        if lane_mode:
             # payload bytes live in the announced SHM lane, not on the
             # socket — drain them there (same framing contract otherwise)
-            dtype_name = dtype_name[len(_SHM_MARK):]
             payload = self._lane_read(conn, peer, plen)
         else:
             payload = (_recv_exact_or_raise(conn, plen) if plen
                        else bytearray())
+        if crc_expected is not None:
+            got = frame_checksum((payload,))
+            if got != crc_expected:
+                raise FrameCorruptError(
+                    peer if peer is not None else -1, tag, plen,
+                    crc_expected, got, self._rx_off.get(id(conn), 0))
+        self._rx_off[id(conn)] = self._rx_off.get(id(conn), 0) + plen
         if tag == _SHM_TAG:
             self._attach_lane(conn, peer, payload)
             return _CONTROL
@@ -582,12 +829,32 @@ class DataPlane:
                 dst, f"never published a data-plane address: {e}") from e
         raw = self._store.get(key)
         host, _, port = raw.decode().rpartition(":")
-        sock = socket.create_connection((host, int(port)),
-                                        timeout=_CONNECT_TIMEOUT)
-        _tune_socket(sock)
-        sock.settimeout(None)
-        sock.sendall(_HELLO.pack(_MAGIC, self.rank, self.generation))
-        return sock
+
+        def _dial():
+            sock = socket.create_connection((host, int(port)), timeout=5.0)
+            try:
+                _tune_socket(sock)
+                sock.settimeout(None)
+                sock.sendall(_HELLO.pack(_MAGIC, self.rank,
+                                         self.generation))
+            except OSError:
+                sock.close()
+                raise
+            return sock
+
+        # bounded exponential backoff instead of a one-shot dial: a peer
+        # mid-restart (listener briefly down, address re-published an
+        # instant later) retries transparently; a peer that stays
+        # unreachable is a named error within _connect_deadline() seconds
+        from ..utils.backoff import BackoffDeadlineError, retry_call
+        try:
+            return retry_call(_dial, timeout=_connect_deadline(),
+                              what=f"dial data-plane peer rank {dst}")
+        except BackoffDeadlineError as e:
+            raise PeerGoneError(
+                dst, f"listener at {host}:{port} unreachable after "
+                f"{e.timeout:.0f}s of bounded-backoff dials "
+                f"(TPU_DIST_DIAL_TIMEOUT): {e.last!r}") from e
 
     def send_array(self, dst: int, tag: str, arr) -> int:
         """Send one array frame to ``dst``; returns payload bytes sent.
@@ -617,12 +884,65 @@ class DataPlane:
             dst, tag, f"q8b{chunk.scheme.block}", (q.size,),
             (memoryview(scales).cast("B"), memoryview(q).cast("B")))
 
+    def _lane_stage(self, lane, parts, plan):
+        """Pre-header SHM staging: copy whatever fits into the lane
+        without blocking; returns the not-yet-staged remainders (sent
+        after the header).  Everything that can fail here fails BEFORE the
+        frame header leaves on TCP, which is what makes mid-stream lane
+        failure recoverable — the caller degrades the frame (and the
+        destination) to inline TCP instead of wedging the ring."""
+        if plan is not None and plan.kind in ("conn-reset", "truncate"):
+            # injected lane breakage (netchaos shm surface): the recovery
+            # contract under test is the TCP fallback, not an error
+            raise ConnectionError(
+                f"netchaos: injected shm lane {plan.kind}")
+        if plan is not None and plan.kind == "slow-drip":
+            # the lane transfer is a memcpy — approximate a slow medium
+            # with the equivalent stall up front
+            time.sleep(sum(len(p) for p in parts) / max(1.0, plan.rate))
+        rest = []
+        for p in parts:
+            if rest:
+                rest.append(p)  # keep strict byte order
+            elif len(p):
+                done = lane.write_some(p)
+                if done < len(p):
+                    rest.append(p[done:])
+        return rest
+
+    def _degrade_lane(self, dst: int, err: BaseException) -> None:
+        """Mid-stream SHM lane failure: drop the lane and pin this
+        destination to inline TCP for the rest of the incarnation.  The
+        established peer socket is untouched, so the frame (and the
+        collective it belongs to) completes over TCP with identical
+        bytes — degraded transport, bitwise-equal result.
+
+        Deliberately NO ``unlink`` here, unlike the send-failure reap:
+        the connection is ALIVE, so the lane announce may still be in
+        flight toward the receiver's reader thread — yanking the name
+        now would fail its attach (and with it the healthy connection).
+        The receiver removes the name at attach as usual; only a
+        receiver that dies before ever attaching leaves one named
+        segment behind (the same bounded crash debris as ``close``)."""
+        stale = self._shm_out.pop(dst, None)
+        self._shm_tried[dst] = True
+        if stale is not None:
+            stale.close()
+        self._obs("shm-lane", dst, role="degraded-to-tcp",
+                  detail=repr(err))
+        try:
+            from ..utils.logging import log_event
+            log_event("shm-lane-degraded", dst=dst, detail=repr(err))
+        except Exception:
+            pass
+
     def _send_frame(self, dst: int, tag: str, dtype_name: str, shape,
                     parts) -> int:
         """Shared outbound path for plain and quantized frames: one
         connection per destination, vectored send (or an SHM-lane payload
-        with a TCP header, for co-located peers), peer death diagnosed
-        outside the send lock."""
+        with a TCP header, for co-located peers), optional payload
+        checksum, deterministic network-fault injection, peer death
+        diagnosed outside the send lock."""
         if dst == self.rank:
             raise ValueError("data plane does not deliver to self")
         parts = [memoryview(p).cast("B") for p in parts]
@@ -635,35 +955,68 @@ class DataPlane:
                     sock = self._connect(dst)
                     self._out[dst] = sock
                 lane = self._maybe_lane(dst, sock) if plen else None
+                # checksum BEFORE fault injection: netchaos `corrupt`
+                # simulates bit flips ON THE WIRE, which is exactly what
+                # the receiver-side verification must catch
+                wire_dtype = dtype_name
+                trailer = b""
+                if frame_crc_enabled():
+                    wire_dtype = _CRC_MARK + dtype_name
+                    trailer = _U32.pack(frame_checksum(parts))
+                plan = None
+                nc = _net_chaos()
+                if nc is not None:
+                    plan = nc.plan("shm" if lane is not None else "tcp",
+                                   src=self.rank, dst=dst)
+                if plan is not None:
+                    if plan.kind == "partition":
+                        # rank-pair blackhole: the frame never leaves.
+                        # The receiver's watchdog names the stalled hop.
+                        return plen
+                    if plan.kind == "delay":
+                        time.sleep(plan.delay)
+                    elif plan.kind == "corrupt":
+                        parts = [memoryview(p).cast("B") for p in
+                                 nc.corrupt_parts(plan, parts)]
                 if lane is not None:
-                    header = _encode_frame_header(
-                        tag.encode(), (_SHM_MARK + dtype_name).encode(),
-                        shape, plen)
-                    # payload FIRST (whatever fits without blocking), then
-                    # the header: by the time the receiver's reader parses
-                    # the header, the bytes are already in the ring — no
-                    # park-and-poll on the consumer's critical path.  Only
-                    # a frame overrunning the ring streams the remainder
-                    # after the header (the receiver drains concurrently).
-                    rest = []
-                    for p in parts:
+                    try:
+                        rest = self._lane_stage(lane, parts, plan)
+                    except (OSError, TimeoutError) as lane_err:
+                        self._degrade_lane(dst, lane_err)
+                        lane = None
+                        plan = None  # the fault WAS the lane breakage —
+                        # it must not fire again on the TCP fallback
+                    else:
+                        # payload FIRST (whatever fit without blocking),
+                        # then header + checksum: by the time the
+                        # receiver's reader parses the header, the bytes
+                        # are already in the ring.  Only a frame
+                        # overrunning the ring streams the remainder
+                        # after the header (the receiver drains
+                        # concurrently).
+                        header = _encode_frame_header(
+                            tag.encode(),
+                            (_SHM_MARK + wire_dtype).encode(),
+                            shape, plen) + trailer
+                        _sendv(sock, header)
                         if rest:
-                            rest.append(p)  # keep strict byte order
-                        elif len(p):
-                            done = lane.write_some(p)
-                            if done < len(p):
-                                rest.append(p[done:])
-                    _sendv(sock, header)
-                    if rest:
-                        timeout = _default_timeout()
-                        abort = self._lane_abort(sock)
-                        for p in rest:
-                            lane.write(p, timeout=timeout,
-                                       abort_check=abort)
-                else:
+                            timeout = _default_timeout()
+                            abort = self._lane_abort(sock)
+                            for p in rest:
+                                lane.write(p, timeout=timeout,
+                                           abort_check=abort)
+                if lane is None:
                     header = _encode_frame_header(
-                        tag.encode(), dtype_name.encode(), shape, plen)
-                    _sendv(sock, header, *parts)
+                        tag.encode(), wire_dtype.encode(), shape,
+                        plen) + trailer
+                    if plan is not None and plan.kind in ("conn-reset",
+                                                          "truncate"):
+                        _inject_break(sock, header, parts, plan)
+                    elif plan is not None and plan.kind == "slow-drip":
+                        _sendv_paced(sock, header, parts, plan.rate)
+                    else:
+                        _sendv(sock, header, *parts)
+            # tpudlint: disable=TD009  # stored in send_err and re-raised below, outside the send lock
             except PeerGoneError as e:
                 send_err = e  # _connect diagnosed the peer; the obs-tail
                 # enrichment still happens below, outside the lock
@@ -808,8 +1161,14 @@ class DataPlane:
                 # report still counts), then a named diagnosis
                 with self._cv:
                     arr = self._pop_locked(src, tag)
+                    named = self._dead_errs.get(src)
                 if arr is not None:
                     return "dataplane", arr
+                if isinstance(named, FrameCorruptError):
+                    # the connection died because a frame failed its
+                    # checksum: surface THAT diagnosis (src/tag/offset),
+                    # not a generic peer-gone
+                    raise named
                 raise self.gone_error(src, dead_detail)
             if deadline is not None and time.monotonic() >= deadline:
                 raise TimeoutError(

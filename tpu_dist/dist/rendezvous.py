@@ -238,6 +238,13 @@ def rendezvous(init_method: Optional[str], world_size: int = -1,
         # code changes needed (tpu_dist/resilience/chaos.py)
         from ..resilience import chaos as _chaos
         chaos_active = _chaos.install_from_env()
+    netchaos_active = None
+    if os.environ.get("TPU_DIST_NETCHAOS"):
+        # network fault injection (tpu_dist/resilience/netchaos.py):
+        # partitions/delays/resets/bit-flips at the transport, store and
+        # serve wire boundaries
+        from ..resilience import netchaos as _netchaos
+        netchaos_active = _netchaos.install_from_env()
     # flight recorder (tpu_dist.obs; armed via TPU_DIST_OBS / launcher
     # --flight-recorder): install the crash-dump paths — unhandled
     # exception, SIGTERM, exit — before anything distributed can hang
@@ -250,6 +257,9 @@ def rendezvous(init_method: Optional[str], world_size: int = -1,
         # resolved process_id is authoritative (mp.spawn and explicit
         # tcp:// ranks never set RANK)
         chaos_active.rank = process_id
+    if netchaos_active is not None:
+        netchaos_active.rank = process_id  # same correction: store/serve
+        # surface faults scope by this process's rank
     if obs_rec is not None:
         # same correction for the recorder: its rank keys the store tail
         # (tpu_dist/g{gen}/obs/{rank}) and the dump filename — a guessed

@@ -233,6 +233,61 @@ class PyTCPStoreServer:
 # to inject deterministic connection faults.  None in production.
 FAULT_HOOK = None
 
+def _net_active():
+    """The active network-fault injector, or None.  Guarded by
+    sys.modules + the env var so processes that never arm netchaos never
+    even import it.  Deliberately a local copy of the canonical probe
+    (``tpu_dist.collectives.transport._net_chaos``, which the serve wire
+    reuses) rather than an import of it: a bare store client must stay
+    light, and importing the transport module would pull numpy into
+    store-only processes.  Keep the two four-line guards in sync."""
+    import sys
+    if "tpu_dist.resilience.netchaos" not in sys.modules \
+            and not os.environ.get("TPU_DIST_NETCHAOS"):
+        return None
+    from ..resilience import netchaos
+    return netchaos.install_from_env()
+
+
+def _net_store_fault(client, op: int, key: str, payload: bytes) -> bytes:
+    """Network-chaos consultation for one store request (the ``store``
+    surface of tpu_dist/resilience/netchaos.py; pure-Python client only,
+    like :data:`FAULT_HOOK`).  May sleep (``delay``/``slow-drip``), close
+    the socket (``conn-reset``/``truncate`` — the reconnect/at-most-once
+    machinery owns recovery), raise a named ``ConnectionError``
+    (``partition`` — unreachable server), or return a bit-flipped payload
+    (``corrupt`` — the consumer's sealed-payload checksum catches it)."""
+    nc = _net_active()
+    if nc is None:
+        return payload
+    f = nc.plan("store")
+    if f is None:
+        return payload
+    if f.kind == "partition":
+        sock = getattr(client, "_sock", None)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        raise ConnectionError(
+            f"netchaos: injected store partition — control-plane server "
+            f"unreachable (op={op} key={key!r})")
+    if f.kind == "delay":
+        time.sleep(f.delay)
+    elif f.kind == "slow-drip":
+        time.sleep(len(payload) / max(1.0, f.rate))
+    elif f.kind in ("conn-reset", "truncate"):
+        sock = getattr(client, "_sock", None)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+    elif f.kind == "corrupt" and payload:
+        return bytes(nc.corrupt_parts(f, (payload,))[0])
+    return payload
+
 # Reads (and the server-side blocking wait) are safe to replay after a lost
 # connection; SET/ADD/DELETE are NOT — the server may have applied the op
 # before the connection died, and a blind resend would double-apply (fatal
@@ -260,16 +315,18 @@ class _PyClient:
 
     @staticmethod
     def _connect(host: str, port: int, timeout: float):
-        deadline = time.monotonic() + timeout
-        while True:
-            try:
-                sock = socket.create_connection((host, port), timeout=5)
-                break
-            except OSError as e:
-                if time.monotonic() > deadline:
-                    raise TimeoutError(
-                        f"could not connect to store at {host}:{port}: {e}")
-                time.sleep(0.05)
+        # bounded exponential backoff under an overall deadline — the
+        # shared retry shape (tpu_dist/utils/backoff.py) replacing the old
+        # flat 50 ms dial loop
+        from ..utils.backoff import BackoffDeadlineError, retry_call
+        try:
+            sock = retry_call(
+                lambda: socket.create_connection((host, port), timeout=5),
+                timeout=timeout, what=f"connect to store at {host}:{port}")
+        except BackoffDeadlineError as e:
+            raise TimeoutError(
+                f"could not connect to store at {host}:{port}: "
+                f"{e.last}") from e
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         sock.settimeout(None)  # GET/WAIT_GE block indefinitely
         return sock
@@ -277,6 +334,7 @@ class _PyClient:
     def request(self, op: int, key: str, payload: bytes = b"") -> bytes:
         if FAULT_HOOK is not None:
             FAULT_HOOK(self, op, key)  # once per logical request, not retry
+        payload = _net_store_fault(self, op, key, payload)
         kb = key.encode()
         msg = (struct.pack("<BI", op, len(kb)) + kb
                + struct.pack("<I", len(payload)) + payload)

@@ -127,6 +127,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "CollectiveMismatchError within "
                         "TPU_DIST_SANITIZE_TIMEOUT instead of hanging "
                         "(tpu_dist/analysis/sanitizer.py)")
+    p.add_argument("--coll_timeout", type=float, default=0.0,
+                   help="end-to-end collective watchdog in every worker "
+                        "(TPU_DIST_COLL_TIMEOUT, seconds): a ring/eager/"
+                        "hierarchical host collective that cannot finish "
+                        "within the budget — a network partition, a "
+                        "wedged peer — raises a named "
+                        "CollectiveTimeoutError identifying the stalled "
+                        "hop (and the flight-recorder position, when "
+                        "armed) instead of waiting out the much longer "
+                        "per-frame TPU_DIST_DP_TIMEOUT. 0 disables")
+    p.add_argument("--netchaos", type=str, default=None,
+                   help="deterministic network fault injection in every "
+                        "worker (TPU_DIST_NETCHAOS, tpu_dist/resilience/"
+                        "netchaos.py): partition/delay/conn-reset/"
+                        "truncate/corrupt/slow-drip faults scoped by "
+                        "rank/peer/surface/frame — e.g. "
+                        "'corrupt:surface=tcp,rank=1,frame=3'")
     p.add_argument("--flight-recorder", "--flight_recorder",
                    dest="flight_recorder", action="store_true",
                    help="arm the per-rank collective flight recorder in "
@@ -276,6 +293,10 @@ def _spawn_world(args, world_size: int, master_port: int,
                     args.heartbeat_timeout)
             if args.sanitize:
                 env["TPU_DIST_SANITIZE"] = "1"
+            if getattr(args, "coll_timeout", 0) > 0:
+                env["TPU_DIST_COLL_TIMEOUT"] = str(args.coll_timeout)
+            if getattr(args, "netchaos", None):
+                env["TPU_DIST_NETCHAOS"] = args.netchaos
             if getattr(args, "obs_dir", None):
                 env["TPU_DIST_OBS"] = "1"
                 env["TPU_DIST_OBS_DIR"] = args.obs_dir

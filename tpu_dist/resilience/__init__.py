@@ -19,6 +19,13 @@ pieces a preemptible multi-host run needs, plus the harness to test them:
   stall a heartbeat, shrink/grow the elastic world) so the restart
   machinery is exercised by tier-1 tests on the CPU backend, not just
   believed.
+- :mod:`~tpu_dist.resilience.netchaos` — the *network* counterpart
+  (``TPU_DIST_NETCHAOS``): rank/peer/surface-scoped partitions, delays,
+  connection resets, truncations, payload bit flips and bandwidth
+  throttles injected at the p2p frame boundary, the SHM lane, the store
+  client and the serve wire — proving every network fault becomes a named
+  bounded error (``FrameCorruptError``, ``CollectiveTimeoutError``,
+  ``PeerGoneError``) or a transparent degraded-mode recovery.
 - :mod:`~tpu_dist.resilience.reshard` — elastic world-size resharding:
   a sharded (ZeRO) checkpoint saved at world N resumes at world M, each
   new rank fetching only the fragments it will own (disk range-reads or
@@ -39,6 +46,10 @@ from .chaos import (GROW_EXIT_CODE, PREEMPTED_EXIT_CODE, Chaos, ChaosError,
                     install_from_env as install_chaos_from_env,
                     uninstall as uninstall_chaos)
 from .heartbeat import Heartbeat, HeartbeatMonitor, RankLostError, hb_key
+from .netchaos import (NetChaos, NetFault, active as active_netchaos,
+                       install as install_netchaos,
+                       install_from_env as install_netchaos_from_env,
+                       uninstall as uninstall_netchaos)
 from .state import TrainState
 
 __all__ = [
@@ -46,5 +57,7 @@ __all__ = [
     "TrainState",
     "Chaos", "ChaosError", "Fault", "active_chaos", "install_chaos",
     "install_chaos_from_env", "uninstall_chaos",
+    "NetChaos", "NetFault", "active_netchaos", "install_netchaos",
+    "install_netchaos_from_env", "uninstall_netchaos",
     "PREEMPTED_EXIT_CODE", "GROW_EXIT_CODE",
 ]

@@ -1,0 +1,268 @@
+"""Deterministic *network* fault injection — the data-plane counterpart of
+:mod:`tpu_dist.resilience.chaos`.
+
+The process-fault harness (``TPU_DIST_CHAOS``) kills, stalls and starves
+whole ranks; this module attacks the wires between them.  Faults are
+declared in the same compact grammar, via ``TPU_DIST_NETCHAOS``::
+
+    TPU_DIST_NETCHAOS="corrupt:surface=tcp,rank=1,frame=2"
+    TPU_DIST_NETCHAOS="partition:rank=0,peer=1;delay:surface=serve,delay=0.05"
+
+Grammar: ``fault[;fault...]`` where ``fault = kind[:k=v[,k=v...]]``.  Kinds
+(all applied at the *sending* side of a wire, so the same spec replays the
+same failure on every run):
+
+=================  ==========================================================
+``partition``      rank-pair blackhole: matching frames silently never
+                   leave (persistent from ``frame=``).  The receiver's
+                   collective watchdog (``TPU_DIST_COLL_TIMEOUT``) turns
+                   the resulting wedge into a named
+                   :class:`~tpu_dist.collectives.transport.CollectiveTimeoutError`
+``delay``          sleep ``delay`` seconds before each matching frame
+                   (persistent) — a congested/lossy link's latency
+``conn-reset``     hard RST mid-frame at the ``frame``-th matching frame
+                   (one-shot): both sides surface
+                   :class:`~tpu_dist.collectives.transport.PeerGoneError`
+``truncate``       send a frame header promising N payload bytes, deliver
+                   half, then close (one-shot): the receiver's framing
+                   layer raises a truncated-frame ``ConnectionError``
+``corrupt``        flip ``flips`` payload bits (seeded, deterministic;
+                   one-shot).  With frame checksums armed
+                   (``TPU_DIST_FRAME_CRC``, default on) the receiver
+                   raises :class:`~tpu_dist.collectives.transport.FrameCorruptError`
+                   naming src/tag/offset — never silent numeric corruption
+``slow-drip``      throttle matching frames to ``rate`` bytes/sec
+                   (persistent) — the degraded-NIC simulation
+=================  ==========================================================
+
+Scoping params (all optional): ``rank=`` the *sending* rank, ``peer=`` the
+destination rank, ``surface=`` one of ``tcp`` (data-plane frame), ``shm``
+(shared-memory lane payload), ``store`` (control-plane client request),
+``serve`` (serving wire frame); ``frame=`` the 1-based index of the
+matching frame/op at which the fault fires (persistent kinds stay armed
+from there on, one-shot kinds fire exactly once).  ``corrupt`` also takes
+``flips=`` (bit count, default 1) and ``seed=``.
+
+Every trigger is *counted*, never timed — like the process chaos harness,
+the same spec reproduces the same failure at the same frame, which is what
+lets the chaos-matrix e2e assert named-error outcomes deterministically.
+
+Injection points (each consults :func:`active` through a lazy call-time
+import — one global read when no chaos is installed):
+
+- ``tpu_dist/collectives/transport.py`` — the p2p frame boundary
+  (``tcp``) and the SHM lane staging path (``shm``).  An ``shm``
+  conn-reset/truncate breaks the lane *before* the frame header leaves,
+  which exercises the mid-stream SHM→TCP degradation path: the frame (and
+  all later ones) ship inline over the established socket and the
+  collective completes bitwise-equal.
+- ``tpu_dist/dist/store.py`` — the pure-Python store client (``store``):
+  ``partition`` raises a named ``ConnectionError`` (unreachable server),
+  ``conn-reset`` closes the socket before the op (the reconnect path),
+  ``corrupt`` flips bits in the request payload (a SET of a pickled
+  collective payload then fails loudly at the consumer's decode).
+- ``tpu_dist/serve/frontend.py`` — the serving wire (``serve``): frames
+  are CRC-protected, so ``corrupt`` fails the connection with
+  ``FrameCorruptError`` and the client's no-silent-drop contract converts
+  it into named handle errors.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import List, Optional
+
+__all__ = ["NetChaos", "NetFault", "parse", "install", "install_from_env",
+           "uninstall", "active", "NET_KINDS", "SURFACES"]
+
+NET_KINDS = ("partition", "delay", "conn-reset", "truncate", "corrupt",
+             "slow-drip")
+SURFACES = ("tcp", "shm", "store", "serve")
+
+# kinds that stay armed from frame= onward vs firing exactly once there
+_PERSISTENT = frozenset({"partition", "delay", "slow-drip"})
+
+
+@dataclasses.dataclass(frozen=True)
+class NetFault:
+    kind: str
+    rank: Optional[int] = None     # sending rank (None = every rank)
+    peer: Optional[int] = None     # destination rank (None = every peer)
+    surface: Optional[str] = None  # tcp | shm | store | serve (None = all)
+    frame: int = 1                 # 1-based matching-frame trigger index
+    delay: float = 0.0             # delay kind
+    rate: float = 0.0              # slow-drip bytes/sec
+    flips: int = 1                 # corrupt bit flips
+    seed: int = 0                  # corrupt determinism
+
+    def __post_init__(self):
+        if self.kind not in NET_KINDS:
+            raise ValueError(f"unknown netchaos fault kind {self.kind!r}; "
+                             f"one of {NET_KINDS}")
+        if self.surface is not None and self.surface not in SURFACES:
+            raise ValueError(f"unknown netchaos surface {self.surface!r}; "
+                             f"one of {SURFACES}")
+        if self.frame < 1:
+            raise ValueError("frame= is 1-based (first matching frame)")
+        if self.kind == "delay" and self.delay <= 0:
+            raise ValueError("delay fault requires delay=<seconds>")
+        if self.kind == "slow-drip" and self.rate <= 0:
+            raise ValueError("slow-drip fault requires rate=<bytes/sec>")
+        if self.flips < 1:
+            raise ValueError("corrupt needs flips >= 1")
+
+
+def parse(spec: str) -> List[NetFault]:
+    """Parse a ``TPU_DIST_NETCHAOS`` spec (module docstring grammar)."""
+    faults = []
+    for part in filter(None, (p.strip() for p in spec.split(";"))):
+        kind, _, params = part.partition(":")
+        kwargs = {}
+        for kv in filter(None, (p.strip() for p in params.split(","))):
+            k, sep, v = kv.partition("=")
+            if not sep:
+                raise ValueError(f"malformed netchaos param {kv!r} in "
+                                 f"{part!r} (expected key=value)")
+            k = k.strip()
+            if k in ("rank", "peer", "frame", "flips", "seed"):
+                kwargs[k] = int(v)
+            elif k in ("delay", "rate"):
+                kwargs[k] = float(v)
+            elif k == "surface":
+                kwargs[k] = v.strip().lower()
+            else:
+                raise ValueError(f"unknown netchaos param {k!r} in {part!r}")
+        faults.append(NetFault(kind.strip(), **kwargs))
+    if not faults:
+        raise ValueError(f"empty netchaos spec {spec!r}")
+    return faults
+
+
+class NetChaos:
+    """The installed network-fault set, bound to this process's rank.
+
+    :meth:`plan` is the single trigger point every injection site calls
+    once per frame/op: it counts the frame against each matching fault's
+    own counter and returns the fault that fires (or None).  Counters are
+    per fault, per process, under one lock — deterministic because every
+    send site serializes through its own per-destination lock and the
+    store/serve clients issue requests in program order.
+    """
+
+    def __init__(self, faults: List[NetFault], rank: Optional[int] = None):
+        self.faults = list(faults)
+        self.rank = (rank if rank is not None
+                     else int(os.environ.get("RANK", "0") or 0))
+        self._mu = threading.Lock()
+        self._counts = [0] * len(self.faults)
+        self._fired = [False] * len(self.faults)
+
+    def _matches(self, f: NetFault, surface: str, src: Optional[int],
+                 dst: Optional[int]) -> bool:
+        if f.surface is not None and f.surface != surface:
+            return False
+        who = src if src is not None else self.rank
+        if f.rank is not None and f.rank != who:
+            return False
+        if f.peer is not None and dst is not None and f.peer != dst:
+            return False
+        return True
+
+    def plan(self, surface: str, src: Optional[int] = None,
+             dst: Optional[int] = None) -> Optional[NetFault]:
+        """Count one frame/op on ``surface`` (from ``src`` to ``dst``) and
+        return the fault that fires on it, if any."""
+        fired = None
+        with self._mu:
+            for i, f in enumerate(self.faults):
+                if not self._matches(f, surface, src, dst):
+                    continue
+                self._counts[i] += 1
+                n = self._counts[i]
+                hit = (n >= f.frame if f.kind in _PERSISTENT
+                       else n == f.frame)
+                if hit and fired is None:
+                    fired = f
+                    if not self._fired[i]:
+                        self._fired[i] = True
+                        self._log(f, surface, src, dst, n)
+        return fired
+
+    @staticmethod
+    def _log(f: NetFault, surface, src, dst, n) -> None:
+        try:
+            from ..utils.logging import log_event
+            log_event(f"netchaos-{f.kind}", surface=surface, src=src,
+                      dst=dst, frame=n)
+        except Exception:
+            pass  # diagnostics must never break the data path
+
+    @staticmethod
+    def corrupt_parts(fault: NetFault, parts):
+        """Flip ``fault.flips`` bits across the concatenated payload parts,
+        deterministically (seeded by the fault + total length).  Returns
+        fresh buffers — the caller's arrays (live gradients!) are never
+        mutated; this simulates corruption *on the wire*, after any
+        checksum was computed."""
+        import random
+        views = [memoryview(p).cast("B") for p in parts]
+        total = sum(len(v) for v in views)
+        if total == 0:
+            return parts
+        rng = random.Random((int(fault.seed) << 24) ^ total)
+        out = [bytearray(v) for v in views]
+        # DISTINCT bit positions: sampling with replacement could hit the
+        # same bit twice and cancel the flip — a deterministic no-op
+        # "corruption" that would silently pass the checksum
+        nbits = total * 8
+        for pos in rng.sample(range(nbits), min(max(1, fault.flips),
+                                                nbits)):
+            byte, bit = divmod(pos, 8)
+            for seg in out:
+                if byte < len(seg):
+                    seg[byte] ^= 1 << bit
+                    break
+                byte -= len(seg)
+        return out
+
+
+_ACTIVE: Optional[NetChaos] = None
+_ACTIVE_SPEC: Optional[str] = None
+
+
+def install(spec: str, rank: Optional[int] = None) -> NetChaos:
+    """Parse ``spec`` and make it the process-wide active network chaos
+    (replaces any previously installed set)."""
+    global _ACTIVE, _ACTIVE_SPEC
+    nc = NetChaos(parse(spec), rank=rank)
+    _ACTIVE, _ACTIVE_SPEC = nc, spec
+    try:
+        from ..utils.logging import log_event
+        log_event("netchaos-installed", rank=nc.rank, spec=spec)
+    except Exception:
+        pass
+    return nc
+
+
+def install_from_env() -> Optional[NetChaos]:
+    """Install from ``TPU_DIST_NETCHAOS`` if set (idempotent: reinstalling
+    the same spec keeps the existing frame counters); None when unset."""
+    spec = os.environ.get("TPU_DIST_NETCHAOS")
+    if not spec:
+        return _ACTIVE
+    if _ACTIVE is not None and _ACTIVE_SPEC == spec:
+        return _ACTIVE
+    return install(spec)
+
+
+def uninstall() -> None:
+    global _ACTIVE, _ACTIVE_SPEC
+    _ACTIVE, _ACTIVE_SPEC = None, None
+
+
+def active() -> Optional[NetChaos]:
+    """The installed :class:`NetChaos`, or None — THE gate every injection
+    site checks (one global read on the disarmed path)."""
+    return _ACTIVE

@@ -23,7 +23,8 @@ benchmark and the tier-1 smoke gate.
 """
 
 from .client import RequestFailedError, ServeClient, ServerGoneError
-from .engine import (QueueFullError, Request, RequestHandle,
+from .engine import (DeadlineExceededError, QueueFullError, Request,
+                     RequestCancelledError, RequestHandle,
                      SchedulerClosedError, SchedulerDrainingError,
                      ServeError, SlotEngine)
 from .frontend import (BACKEND_KEY, GATEWAY_KEY, Frontend, Gateway,
@@ -33,5 +34,6 @@ from .scheduler import Scheduler
 __all__ = ["SlotEngine", "Scheduler", "Frontend", "Gateway", "ServeClient",
            "Request", "RequestHandle", "ServeError", "QueueFullError",
            "SchedulerDrainingError", "SchedulerClosedError",
+           "DeadlineExceededError", "RequestCancelledError",
            "RequestFailedError", "ServerGoneError",
            "BACKEND_KEY", "GATEWAY_KEY", "store_from_env"]

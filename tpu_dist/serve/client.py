@@ -55,15 +55,19 @@ class ServeClient:
                  connect_retry: float = 0.0):
         self.host, self.port = host, int(port)
         self.timeout = float(timeout)
-        deadline = time.monotonic() + max(0.0, connect_retry)
-        while True:
+        if connect_retry > 0:
+            from ..utils.backoff import BackoffDeadlineError, retry_call
             try:
-                self._sock = connect_hello(host, port, timeout=timeout)
-                break
-            except (OSError, ConnectionError):
-                if time.monotonic() >= deadline:
-                    raise
-                time.sleep(0.2)
+                self._sock = retry_call(
+                    lambda: connect_hello(host, port, timeout=timeout),
+                    timeout=connect_retry,
+                    what=f"connect to serve endpoint {host}:{port}")
+            except BackoffDeadlineError as e:
+                raise (e.last if isinstance(e.last, (OSError,
+                                                     ConnectionError))
+                       else e) from e
+        else:
+            self._sock = connect_hello(host, port, timeout=timeout)
         self._send_mu = threading.Lock()
         self._mu = threading.Lock()
         self._handles: Dict[int, RequestHandle] = {}
@@ -77,15 +81,22 @@ class ServeClient:
 
     def submit(self, prompt, max_new_tokens: int = 16,
                temperature: float = 0.0, eos_id: Optional[int] = None,
-               seed: int = 0) -> RequestHandle:
+               seed: int = 0,
+               deadline_ms: Optional[float] = None) -> RequestHandle:
         """Send one request; returns its streaming handle.  Raises
-        :class:`ServerGoneError` if the connection is already dead."""
+        :class:`ServerGoneError` if the connection is already dead.
+        ``deadline_ms`` is the server-side end-to-end budget: past it the
+        request is shed/slot-freed and the handle terminates with a
+        ``DeadlineExceededError``-naming :class:`RequestFailedError`.
+        The handle's ``cancel()`` sends a ``cancel`` frame — the server
+        frees the slot at its next iteration boundary."""
         with self._mu:
             if self._closed:
                 raise ServerGoneError("client is closed")
             rid = self._next_id
             self._next_id += 1
             handle = RequestHandle(rid)
+            handle._cancel = lambda: self._send_cancel(rid)
             self._handles[rid] = handle
         frame = {"type": "submit", "id": rid,
                  "prompt": [int(t) for t in prompt],
@@ -93,6 +104,8 @@ class ServeClient:
                  "temperature": float(temperature),
                  "eos_id": None if eos_id is None else int(eos_id),
                  "seed": int(seed)}
+        if deadline_ms is not None:
+            frame["deadline_ms"] = float(deadline_ms)
         try:
             send_frame(self._sock, frame, lock=self._send_mu)
         except (OSError, ConnectionError) as e:
@@ -100,6 +113,13 @@ class ServeClient:
                 f"connection to {self.host}:{self.port} lost: {e!r}"))
             raise self._handles_error()
         return handle
+
+    def _send_cancel(self, rid: int) -> None:
+        try:
+            send_frame(self._sock, {"type": "cancel", "id": rid},
+                       lock=self._send_mu)
+        except (OSError, ConnectionError):
+            pass  # a dead connection already fails every handle by name
 
     def generate(self, prompt, max_new_tokens: int = 16,
                  timeout: float = 120.0, **kw) -> list:

@@ -45,7 +45,8 @@ from ..utils.metrics import LatencyHistogram
 
 __all__ = ["SlotEngine", "Request", "RequestHandle", "ServeError",
            "QueueFullError", "SchedulerDrainingError",
-           "SchedulerClosedError"]
+           "SchedulerClosedError", "DeadlineExceededError",
+           "RequestCancelledError", "error_outcome"]
 
 
 class ServeError(RuntimeError):
@@ -66,6 +67,28 @@ class SchedulerDrainingError(ServeError):
 class SchedulerClosedError(ServeError):
     """The scheduler shut down with this request still queued or decoding:
     the request did not complete, and this names why."""
+
+
+class DeadlineExceededError(ServeError):
+    """The request's ``deadline_ms`` passed before it finished: queued
+    requests are shed before staging (they would be stale on arrival),
+    decoding requests free their slot at the next iteration boundary —
+    load shedding by deadline instead of latency collapse."""
+
+
+class RequestCancelledError(ServeError):
+    """The request was cancelled (client disconnect, or an explicit
+    ``cancel`` frame) — its slot was freed at the next iteration boundary
+    instead of decoding to ``max_new_tokens`` for nobody."""
+
+
+def error_outcome(exc: BaseException) -> str:
+    """The obs-span outcome string for a failed request.  Cancellation is
+    a first-class outcome (``error:Cancelled``) rather than an exception
+    class name — the span vocabulary `obs diagnose` keys on."""
+    if isinstance(exc, RequestCancelledError):
+        return "error:Cancelled"
+    return f"error:{type(exc).__name__}"
 
 
 def _now() -> float:
@@ -90,6 +113,16 @@ class RequestHandle:
         self._tokens: List[int] = []
         self._reason: Optional[str] = None
         self._error: Optional[BaseException] = None
+        self._cancel: Optional[Callable[[], None]] = None
+
+    def cancel(self) -> None:
+        """Request cancellation: the serving side frees the slot at the
+        next iteration boundary and the handle terminates with
+        :class:`RequestCancelledError`.  No-op when already terminal or
+        when no cancel path is wired (bare handles)."""
+        cb = self._cancel
+        if cb is not None:
+            cb()
 
     # -- producer side (engine/scheduler/client reader) ----------------------
 
@@ -183,6 +216,7 @@ class Request:
     def __init__(self, prompt, max_new_tokens: int,
                  temperature: float = 0.0, eos_id: Optional[int] = None,
                  seed: int = 0, req_id: Optional[int] = None,
+                 deadline_ms: Optional[float] = None,
                  on_token: Optional[Callable] = None,
                  on_done: Optional[Callable] = None,
                  on_error: Optional[Callable] = None):
@@ -196,11 +230,24 @@ class Request:
         self.on_done = on_done
         self.on_error = on_error
         self.t_submit = _now()
+        # absolute monotonic deadline: past it the request is shed (if
+        # still queued) or its slot freed at the next iteration boundary
+        self.deadline: Optional[float] = (
+            None if deadline_ms is None
+            else self.t_submit + float(deadline_ms) / 1000.0)
+        self.cancelled = False      # single-writer flag (GIL-safe)
         self.t_admit: Optional[float] = None
         self.t_first: Optional[float] = None
         self.emitted = 0
         self.staged = None          # (padded device/np prompt, bucket len)
         self.obs_span = None        # armed flight-recorder span (or None)
+
+    def cancel(self) -> None:
+        self.cancelled = True
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return (self.deadline is not None
+                and (now if now is not None else _now()) >= self.deadline)
 
     def emit(self, token: int) -> None:
         self.emitted += 1
@@ -381,7 +428,16 @@ class SlotEngine:
     def admit(self, req: Request) -> int:
         """Prefill ``req`` into a free slot and emit its first token.
         Returns the slot index; raises ``RuntimeError`` when no slot is
-        free (callers check :meth:`free_slots` first)."""
+        free (callers check :meth:`free_slots` first).  Cancelled or
+        past-deadline requests are refused by name BEFORE the prefill —
+        shedding stale load instead of spending a compiled program on it."""
+        if req.cancelled:
+            raise RequestCancelledError(
+                f"request {req.id} was cancelled before admission")
+        if req.expired():
+            raise DeadlineExceededError(
+                f"request {req.id} missed its deadline before admission "
+                f"(deadline_ms elapsed in the queue) — shed")
         free = np.flatnonzero(~self.active)
         if len(free) == 0:
             raise RuntimeError("no free slot (check free_slots() first)")
@@ -470,12 +526,41 @@ class SlotEngine:
         req = self.slot_req[slot]
         self._free(slot)
         if req is not None:
-            self._obs_end(req, f"error:{type(exc).__name__}")
+            self._obs_end(req, error_outcome(exc))
             req.fail(exc)
 
     def fail_all(self, exc: BaseException) -> None:
         for slot in np.flatnonzero(self.active):
             self.fail_slot(int(slot), exc)
+
+    def sweep_expired(self) -> int:
+        """Free slots whose requests were cancelled (client disconnect /
+        explicit cancel) or ran past their ``deadline_ms`` — called by the
+        scheduler loop at EVERY iteration boundary, so a cancelled request
+        stops occupying a slot after at most one decode step instead of
+        decoding to ``max_new_tokens`` for nobody.  The request terminates
+        with the named error and its obs span closes ``error:Cancelled`` /
+        ``error:DeadlineExceededError``.  Returns the slots freed."""
+        n = 0
+        now = _now()
+        for slot in np.flatnonzero(self.active):
+            slot = int(slot)
+            req = self.slot_req[slot]
+            if req is None:
+                continue
+            if req.cancelled:
+                self.fail_slot(slot, RequestCancelledError(
+                    f"request {req.id} cancelled after {req.emitted} "
+                    f"token(s); slot {slot} freed at the iteration "
+                    f"boundary"))
+                n += 1
+            elif req.expired(now):
+                self.fail_slot(slot, DeadlineExceededError(
+                    f"request {req.id} exceeded its deadline_ms after "
+                    f"{req.emitted} token(s); slot {slot} freed at the "
+                    f"iteration boundary"))
+                n += 1
+        return n
 
     def _free(self, slot: int) -> None:
         self.active[slot] = False

@@ -47,7 +47,8 @@ import threading
 import time
 from typing import Dict, Optional, Tuple
 
-from ..collectives.transport import _recv_exact, _sendv, _tune_socket
+from ..collectives.transport import (FrameCorruptError, _recv_exact,
+                                     _sendv, _tune_socket, frame_checksum)
 from .scheduler import Scheduler
 
 __all__ = ["Frontend", "Gateway", "BACKEND_KEY", "GATEWAY_KEY",
@@ -55,9 +56,53 @@ __all__ = ["Frontend", "Gateway", "BACKEND_KEY", "GATEWAY_KEY",
 
 _MAGIC = b"TPSV"
 _HELLO = struct.Struct("<4sH")   # magic, protocol version
-_VERSION = 1
+# v2: every frame carries a payload checksum (u32 length || u32 crc ||
+# json) — serve frames are tiny, so integrity is unconditional here; a
+# flipped bit on the request wire fails the connection with a named
+# FrameCorruptError instead of decoding to silently wrong tokens
+_VERSION = 2
 _U32 = struct.Struct("<I")
 _MAX_FRAME = 64 << 20
+
+
+def _net_serve_fault(sock, payload: bytes) -> bytes:
+    """netchaos ``serve`` surface (tpu_dist/resilience/netchaos.py): one
+    consultation per outgoing frame.  May sleep (``delay``), pace
+    (``slow-drip``), return a bit-flipped payload (``corrupt`` — the
+    receiver's frame checksum catches it), break the socket mid-frame
+    (``conn-reset`` / ``truncate``), or blackhole the frame entirely
+    (``partition`` — the caller's deadline-bounded waits own the rest).
+    Returns the payload to send, or None for blackholed frames.  Called
+    under the connection's send lock (see :func:`send_frame`): the raw
+    truncate/reset writes must not interleave with a concurrent writer's
+    frame."""
+    import time as _time
+    from ..collectives.transport import _net_chaos
+    nc = _net_chaos()  # THE shared sys.modules+env-guarded probe
+    if nc is None:
+        return payload
+    f = nc.plan("serve")
+    if f is None:
+        return payload
+    if f.kind == "partition":
+        return None
+    if f.kind == "delay":
+        _time.sleep(f.delay)
+    elif f.kind == "slow-drip":
+        _time.sleep(len(payload) / max(1.0, f.rate))
+    elif f.kind == "corrupt":
+        return bytes(nc.corrupt_parts(f, (payload,))[0])
+    elif f.kind in ("conn-reset", "truncate"):
+        try:
+            if f.kind == "truncate":
+                sock.sendall(_U32.pack(len(payload) + 1000))  # lies, then
+                sock.shutdown(socket.SHUT_WR)                 # FIN
+            sock.close()
+        except OSError:
+            pass
+        raise ConnectionResetError(
+            f"netchaos: injected serve-wire {f.kind}")
+    return payload
 
 # cross-generation service-discovery keys (like tpu_dist/master_port):
 # written by whichever incarnation currently owns the role, read by the
@@ -68,22 +113,36 @@ GATEWAY_KEY = "tpu_dist/serve/gateway"
 
 
 def send_frame(sock, obj: dict, lock: Optional[threading.Lock] = None) -> None:
-    """One length-prefixed JSON frame, vectored send (header + payload in
-    one syscall).  ``lock`` serializes concurrent writers on a shared
-    connection (token frames for different requests interleave)."""
+    """One checksummed length-prefixed JSON frame, vectored send (header +
+    payload in one syscall).  ``lock`` serializes concurrent writers on a
+    shared connection (token frames for different requests interleave) —
+    fault injection runs under it too, so an injected truncate/reset
+    cannot interleave raw bytes into another writer's in-flight frame."""
     payload = json.dumps(obj).encode()
-    header = _U32.pack(len(payload))
+    # checksum BEFORE fault injection: netchaos `corrupt` simulates bit
+    # flips on the wire, which is what the receiver must catch
+    header = _U32.pack(len(payload)) + _U32.pack(frame_checksum((payload,)))
     if lock is None:
-        _sendv(sock, header, payload)
+        _send_frame_faulted(sock, header, payload)
     else:
         with lock:
-            _sendv(sock, header, payload)
+            _send_frame_faulted(sock, header, payload)
+
+
+def _send_frame_faulted(sock, header: bytes, payload: bytes) -> None:
+    faulted = _net_serve_fault(sock, payload)
+    if faulted is None:
+        return  # netchaos partition: the frame never leaves
+    _sendv(sock, header, faulted)
 
 
 def read_frame(sock) -> Optional[dict]:
     """Next frame, or None on EOF at a frame boundary (clean close).
     Raises ``ConnectionError`` on a truncated frame or an oversized
-    length prefix (a desynced/hostile peer, not a request)."""
+    length prefix (a desynced/hostile peer, not a request), and a named
+    :class:`~tpu_dist.collectives.transport.FrameCorruptError` when the
+    payload fails its checksum (protocol v2: u32 len || u32 crc ||
+    json)."""
     raw = _recv_exact(sock, _U32.size)
     if raw is None:
         return None
@@ -91,10 +150,21 @@ def read_frame(sock) -> Optional[dict]:
     if n > _MAX_FRAME:
         raise ConnectionError(f"frame length {n} exceeds the "
                               f"{_MAX_FRAME}-byte bound")
+    (crc,) = _U32.unpack(bytes(_recv_exact_or_close(sock, _U32.size)))
     body = _recv_exact(sock, n)
     if body is None:
         raise ConnectionError("connection closed mid-frame")
+    got = frame_checksum((body,))
+    if got != crc:
+        raise FrameCorruptError(None, "serve-frame", n, crc, got, 0)
     return json.loads(bytes(body).decode())
+
+
+def _recv_exact_or_close(sock, n: int):
+    raw = _recv_exact(sock, n)
+    if raw is None:
+        raise ConnectionError("connection closed mid-frame")
+    return raw
 
 
 def connect_hello(host: str, port: int, timeout: float = 10.0):
@@ -178,9 +248,11 @@ class _Listener:
 class Frontend(_Listener):
     """Engine-side frame server: accepts serve-protocol connections and
     feeds the scheduler; per-request tokens stream back as they are
-    emitted.  A dead client's requests keep decoding (the engine does not
-    support mid-decode cancellation yet) but their frames are dropped at
-    the closed socket — bounded by the request's ``max_new_tokens``."""
+    emitted.  A client that disconnects (or sends a ``cancel`` frame)
+    mid-decode has its in-flight requests cancelled: the engine frees
+    their slots at the next iteration boundary and the obs spans close
+    ``outcome=error:Cancelled`` — no decode steps are spent on a request
+    nobody is reading."""
 
     def __init__(self, scheduler: Scheduler, host: str = "127.0.0.1",
                  port: int = 0, store=None):
@@ -231,21 +303,31 @@ class Frontend(_Listener):
                 frame = read_frame(conn)
                 if frame is None:
                     break
-                if frame.get("type") != "submit":
+                kind = frame.get("type")
+                if kind == "cancel":
+                    # explicit client cancellation: the slot frees at the
+                    # next iteration boundary, the handle terminates with
+                    # the named RequestCancelledError frame
+                    h = handles.get(frame.get("id"))
+                    if h is not None:
+                        h.cancel()
+                    continue
+                if kind != "submit":
                     _send({"type": "error", "id": frame.get("id"),
                            "error": "ProtocolError",
-                           "detail": f"unknown frame type "
-                                     f"{frame.get('type')!r}"})
+                           "detail": f"unknown frame type {kind!r}"})
                     continue
                 rid = frame.get("id")
                 on_token, on_done, on_error = _callbacks(rid)
                 try:
+                    dl = frame.get("deadline_ms")
                     handles[rid] = self.scheduler.submit(
                         frame["prompt"],
                         max_new_tokens=int(frame.get("max_new_tokens", 16)),
                         temperature=float(frame.get("temperature", 0.0)),
                         eos_id=frame.get("eos_id"),
                         seed=int(frame.get("seed", 0)),
+                        deadline_ms=None if dl is None else float(dl),
                         req_id=rid, on_token=on_token, on_done=on_done,
                         on_error=on_error)
                     if handles[rid].done:
@@ -259,6 +341,15 @@ class Frontend(_Listener):
             pass
         finally:
             alive[0] = False
+            # client gone: cancel everything it still had in flight — the
+            # engine frees the slots at the next iteration boundary and
+            # each request's obs span closes outcome=error:Cancelled,
+            # instead of decoding to max_new_tokens into a dead socket
+            for h in list(handles.values()):
+                try:
+                    h.cancel()
+                except Exception:
+                    pass
             try:
                 conn.close()
             except OSError:
@@ -322,20 +413,27 @@ class Gateway(_Listener):
         return host, int(port)
 
     def _connect_backend(self):
-        """Bounded retry loop: the backend may be mid-restart.  Raises
+        """Bounded backend (re-)resolution: the backend key is re-read and
+        the dial retried under the shared exponential-backoff helper
+        (tpu_dist/utils/backoff.py) — a backend mid-restart republishes a
+        fresh address and the next dial lands on it.  Raises
         ``ConnectionError`` after ``backend_timeout``."""
+        from ..utils.backoff import BackoffDeadlineError, retry_call
         deadline = time.monotonic() + self.backend_timeout
-        last = None
-        while time.monotonic() < deadline:
-            try:
-                host, port = self._resolve_backend(deadline)
-                return connect_hello(host, port, timeout=5.0)
-            except (OSError, ConnectionError, TimeoutError) as e:
-                last = e
-                time.sleep(0.25)
-        raise ConnectionError(
-            f"no serving backend reachable within "
-            f"{self.backend_timeout:.0f}s (last error: {last!r})")
+
+        def dial():
+            host, port = self._resolve_backend(deadline)
+            return connect_hello(host, port, timeout=5.0)
+
+        try:
+            return retry_call(dial, timeout=self.backend_timeout,
+                              what="resolve+dial serving backend",
+                              base=0.1, cap=1.0)
+        except BackoffDeadlineError as e:
+            raise ConnectionError(
+                f"no serving backend reachable within "
+                f"{self.backend_timeout:.0f}s (last error: "
+                f"{e.last!r})") from e
 
     def _serve_conn(self, conn) -> None:
         if not self._hello(conn):
@@ -383,11 +481,23 @@ class _GatewaySession:
                 return
             if frame is None:
                 return
-            if frame.get("type") != "submit":
+            kind = frame.get("type")
+            if kind == "cancel":
+                # forward only when a backend session exists — a cancel
+                # for a request that never reached a backend is a no-op
+                with self._backend_mu:
+                    b = self._backend
+                if b is not None:
+                    try:
+                        send_frame(b, frame)
+                    except (OSError, ConnectionError):
+                        pass  # the pump's sweep owns this backend's death
+                continue
+            if kind != "submit":
                 self._to_client({"type": "error", "id": frame.get("id"),
                                  "error": "ProtocolError",
                                  "detail": f"unknown frame type "
-                                           f"{frame.get('type')!r}"})
+                                           f"{kind!r}"})
                 continue
             self._forward(frame)
 

@@ -29,9 +29,10 @@ import threading
 import time
 from typing import Callable, Optional
 
-from .engine import (QueueFullError, Request, RequestHandle,
+from .engine import (DeadlineExceededError, QueueFullError, Request,
+                     RequestCancelledError, RequestHandle,
                      SchedulerClosedError, SchedulerDrainingError,
-                     SlotEngine)
+                     SlotEngine, error_outcome)
 
 __all__ = ["Scheduler"]
 
@@ -76,11 +77,19 @@ class Scheduler:
     def submit(self, prompt, max_new_tokens: int = 16,
                temperature: float = 0.0, eos_id: Optional[int] = None,
                seed: int = 0, req_id: Optional[int] = None,
+               deadline_ms: Optional[float] = None,
                on_token: Optional[Callable] = None,
                on_done: Optional[Callable] = None,
                on_error: Optional[Callable] = None,
                timeout: float = 5.0) -> RequestHandle:
         """Queue one request; returns its handle (stream + terminal state).
+
+        ``deadline_ms`` is an end-to-end budget from submit: a request
+        still queued past it is shed by name before staging, one still
+        decoding frees its slot at the next iteration boundary — both
+        terminate the handle with :class:`DeadlineExceededError`.  The
+        handle's :meth:`~tpu_dist.serve.engine.RequestHandle.cancel`
+        releases the slot the same way (``RequestCancelledError``).
 
         Raises :class:`SchedulerDrainingError` while draining,
         :class:`SchedulerClosedError` after close, :class:`QueueFullError`
@@ -113,8 +122,10 @@ class Scheduler:
 
         req = Request(prompt, max_new_tokens, temperature=temperature,
                       eos_id=eos_id, seed=seed, req_id=req_id,
+                      deadline_ms=deadline_ms,
                       on_token=_tok, on_done=_done, on_error=_err)
         handle.id = req.id
+        handle._cancel = req.cancel  # frees the slot at the next boundary
         SlotEngine.obs_open(req)
         try:
             self._pending.put(req, timeout=timeout)
@@ -122,7 +133,7 @@ class Scheduler:
             exc = QueueFullError(
                 f"admission queue full ({self._pending.maxsize} pending); "
                 f"shed load or retry")
-            self.engine._obs_end(req, f"error:{type(exc).__name__}")
+            self.engine._obs_end(req, error_outcome(exc))
             raise exc
         if self._stop.is_set():
             # close() may have drained the queues while this put was
@@ -130,7 +141,7 @@ class Scheduler:
             # a queue nobody reads.  Fail it by name (idempotent if the
             # close-side drain already did) and refuse the submit.
             exc = self._closed_error()
-            self.engine._obs_end(req, f"error:{type(exc).__name__}")
+            self.engine._obs_end(req, error_outcome(exc))
             req.fail(exc)
             raise exc
         return handle
@@ -208,10 +219,15 @@ class Scheduler:
             except queue.Empty:
                 continue
             try:
+                shed = self._shed_stale(req)
+                if shed is not None:
+                    self.engine._obs_end(req, error_outcome(shed))
+                    req.fail(shed)
+                    continue
                 try:
                     self.engine.stage(req)
                 except Exception as e:   # bad request: not a stage killer
-                    self.engine._obs_end(req, f"error:{type(e).__name__}")
+                    self.engine._obs_end(req, error_outcome(e))
                     req.fail(e)
                     continue
                 placed = False
@@ -227,7 +243,7 @@ class Scheduler:
                     # it still terminates with the named error, never
                     # silently
                     exc = self._closed_error()
-                    self.engine._obs_end(req, f"error:{type(exc).__name__}")
+                    self.engine._obs_end(req, error_outcome(exc))
                     req.fail(exc)
             finally:
                 # the pending pop is fully handled (staged OR failed) —
@@ -240,10 +256,23 @@ class Scheduler:
             # producer into _staged, our exit sweep is the last word
             self._fail_queued(self._closed_error(), count=False)
 
+    def _shed_stale(self, req: Request):
+        """The named shed error for a queued request that should never
+        reach the engine (cancelled, or past its deadline), else None."""
+        if req.cancelled:
+            return RequestCancelledError(
+                f"request {req.id} cancelled while queued — shed before "
+                f"staging")
+        if req.expired():
+            return DeadlineExceededError(
+                f"request {req.id} spent its whole deadline_ms in the "
+                f"admission queue — shed before staging (overload)")
+        return None
+
     def _drain_failed(self, req: Request) -> None:
         exc = SchedulerDrainingError("request rejected: scheduler started "
                                      "draining before it was admitted")
-        self.engine._obs_end(req, f"error:{type(exc).__name__}")
+        self.engine._obs_end(req, error_outcome(exc))
         req.fail(exc)
 
     def _reject_queued(self) -> None:
@@ -268,7 +297,7 @@ class Scheduler:
                     req = q.get_nowait()
                 except queue.Empty:
                     break
-                self.engine._obs_end(req, f"error:{type(exc).__name__}")
+                self.engine._obs_end(req, error_outcome(exc))
                 req.fail(exc)
                 if count:
                     q.task_done()
@@ -277,7 +306,7 @@ class Scheduler:
         try:
             self.engine.admit(req)
         except Exception as e:   # a bad request must not kill the loop
-            self.engine._obs_end(req, f"error:{type(e).__name__}")
+            self.engine._obs_end(req, error_outcome(e))
             req.fail(e)
         finally:
             self._staged.task_done()
@@ -319,6 +348,8 @@ class Scheduler:
                     self._staged.task_done()
                 held, window_start = [], None
                 self._reject_queued()
+                self.engine.sweep_expired()  # cancelled slots free even
+                # while draining — the drain must not wait on them
                 if not self.engine.idle():
                     if not self._step_once():
                         break
@@ -327,6 +358,11 @@ class Scheduler:
                         self._idle_cv.notify_all()
                     time.sleep(0.01)
                 continue
+            # -- the iteration boundary: cancelled / past-deadline slots
+            # free HERE, before admission sees the free-slot count — a
+            # disconnected client's request stops costing decode steps
+            # after at most one iteration
+            self.engine.sweep_expired()
             # -- pull staged arrivals (never beyond the free slots) ----------
             while len(held) < self.engine.free_slots():
                 try:
@@ -366,7 +402,7 @@ class Scheduler:
         # loop exit: requests still held in the window are not dropped
         exc = self._closed_error()
         for req in held:
-            self.engine._obs_end(req, f"error:{type(exc).__name__}")
+            self.engine._obs_end(req, error_outcome(exc))
             req.fail(exc)
             self._staged.task_done()
         if self._fatal is not None:

@@ -1,6 +1,7 @@
 """tpu_dist.utils — observability helpers (SURVEY.md §5: the reference's
 tracing/metrics rows are bare prints; these are the structured equivalents)."""
 
+from .backoff import BackoffDeadlineError, retry_call
 from .logging import MetricLogger, log_event, rank_zero_print
 from .memory import (max_memory_allocated, mem_get_info, memory_allocated,
                      memory_stats, memory_summary)
@@ -11,6 +12,7 @@ from .profiler import StepTimer, trace
 
 __all__ = ["rank_zero_print", "MetricLogger", "log_event", "StepTimer",
            "trace",
+           "retry_call", "BackoffDeadlineError",
            "topk_accuracy", "accuracy", "confusion_matrix",
            "record_collective", "collective_counters",
            "reset_collective_counters", "LatencyHistogram",

@@ -1,0 +1,61 @@
+"""Bounded exponential backoff under an overall deadline — THE retry shape.
+
+Three dials used to exist in three hand-rolled forms: the data plane's
+peer connect was a one-shot ``create_connection`` (a peer mid-restart
+failed the whole collective), the store client's connect loop slept a
+flat 50 ms forever-ish, and the serve gateway retried its backend every
+250 ms.  One implementation now owns the shape every reconnect path
+needs: exponential backoff (base doubling to a cap) under an *overall*
+deadline, so a dead peer is a named, bounded error and a restarting peer
+is a transparent retry — never an unbounded dial loop (tpudlint TD004's
+runtime complement).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Tuple, Type
+
+__all__ = ["retry_call", "BackoffDeadlineError"]
+
+
+class BackoffDeadlineError(TimeoutError):
+    """Every retry of an operation failed before its overall deadline.
+    ``last`` is the final attempt's exception (also chained as the
+    ``__cause__``), ``attempts`` how many dials were made."""
+
+    def __init__(self, what: str, timeout: float, attempts: int,
+                 last: BaseException):
+        self.what = what
+        self.timeout = float(timeout)
+        self.attempts = int(attempts)
+        self.last = last
+        super().__init__(
+            f"{what}: still failing after {timeout:.1f}s "
+            f"({attempts} attempt{'s' if attempts != 1 else ''}, "
+            f"last error: {last!r})")
+
+
+def retry_call(fn: Callable[[], object], timeout: float,
+               what: str = "operation", base: float = 0.05, cap: float = 2.0,
+               retry_on: Tuple[Type[BaseException], ...] = (
+                   OSError, TimeoutError)):
+    """Call ``fn`` until it succeeds or ``timeout`` seconds elapse.
+
+    Failures matching ``retry_on`` sleep ``base`` doubling up to ``cap``
+    (clipped to the remaining budget) and retry; the deadline expiring
+    raises :class:`BackoffDeadlineError` naming the operation, the budget
+    and the last error.  Other exceptions propagate immediately — only
+    transient connection-shaped failures are retried."""
+    deadline = time.monotonic() + max(0.0, float(timeout))
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except retry_on as e:
+            attempt += 1
+            now = time.monotonic()
+            if now >= deadline:
+                raise BackoffDeadlineError(what, timeout, attempt, e) from e
+            delay = min(cap, base * (2 ** (attempt - 1)))
+            time.sleep(max(0.0, min(delay, deadline - now)))
