@@ -128,9 +128,10 @@ def _better(new: dict, old: dict) -> dict:
 
 def main() -> None:
     sys.path.insert(0, _REPO)
-    from benchmarks import (attention, bench_serve, generate, imagenet_e2e,
-                            input_pipeline, moe_lm, resnet_cifar, scaling,
-                            transformer_lm, vit_train)
+    from benchmarks import (attention, bench_roles, bench_serve, generate,
+                            imagenet_e2e, input_pipeline, moe_lm,
+                            resnet_cifar, scaling, transformer_lm,
+                            vit_train)
 
     out = os.path.join(_REPO, "BENCH_EXTENDED.json")
     previous = {}
@@ -160,6 +161,7 @@ def main() -> None:
         "gen_latency_int8": "transformer_lm_decode_batch1_int8_tokens_per_sec",
         "gen_long_int8_cache": "transformer_lm_decode_long_context_int8_cache",
         "serve": "serve_continuous_batching_tokens_per_sec",
+        "roles": "roles_channel_dp_best_mb_s",
     }
     import bench  # repo-root headline (MNIST ConvNet) — ratchet a copy here
     results = []
@@ -181,7 +183,8 @@ def main() -> None:
                      ("gen_latency_int8", generate.run_latency_int8),
                      ("gen_long_int8_cache",
                       generate.run_long_context_int8_cache),
-                     ("serve", bench_serve.run)):
+                     ("serve", bench_serve.run),
+                     ("roles", bench_roles.run)):
         try:
             r = fn()
         except Exception as e:  # record the failure, keep the rest running

@@ -1,0 +1,227 @@
+"""Actor/learner training over a role graph — the tpu_dist.roles example.
+
+The Launchpad shape (docs/roles.md): N **actor** ranks generate batches
+("trajectories") on CPU and push them over a bounded channel; ONE
+**learner** rank consumes them, trains the MNIST ConvNet with bucketed
+grad application, and periodically broadcasts fresh parameters back over
+a reverse "latest" register the actors poll.  Run it under the role-graph
+launcher::
+
+    python -m tpu_dist.launch --roles learner:1,actor:4:solo \
+        --max_restarts=1 examples/actor_learner.py --out ./al_out
+
+The actors carry the ``solo`` restart policy: kill one mid-run
+(``TPU_DIST_CHAOS="kill:rank=2,step=3"``) and the supervisor respawns
+exactly that rank in the SAME generation — the learner never stops, and
+the restarted actor's very next ``put`` lands on the same named channel,
+because the queue cursor lives in the store, not in any process.  A dead
+*learner* would instead fail the gang round (policy ``gang``) and
+relaunch everyone at the next generation with a fresh channel keyspace.
+
+Wire shapes exercised: the trajectory channel is MPMC (4 producers → 1
+consumer; image batches above ``TPU_DIST_DP_THRESHOLD`` ride the p2p
+data plane as raw CRC'd frames, the envelope rides the sealed store
+path); the parameter channel is a versioned "latest" register — actors
+want the freshest weights, not every intermediate.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))  # run as a script without install
+
+GET_TIMEOUT = 120.0   # learner's per-batch budget
+PUT_TIMEOUT = 60.0    # actor's backpressure budget
+
+
+def build_graph(n_actors: int):
+    from tpu_dist.roles import ChannelSpec, Role, RoleGraph
+    return RoleGraph(
+        roles=[Role("learner", 1),
+               Role("actor", n_actors, restart="solo")],
+        channels=[ChannelSpec("traj", src="actor", dst="learner", depth=16),
+                  ChannelSpec("params", src="learner", dst="actor",
+                              kind="latest")])
+
+
+def run_learner(ctx, args):
+    import jax
+    import numpy as np
+
+    from tpu_dist import collectives as C
+    from tpu_dist import optim, resilience
+    from tpu_dist.models import ConvNet
+    from tpu_dist.nn import functional as F
+    from tpu_dist.roles import ChannelTimeoutError
+
+    model = ConvNet()
+    params = model.init(jax.random.PRNGKey(0))
+    opt = optim.Adam(lr=args.lr)
+    opt_state = opt.init(params)
+    bucketer = C.Bucketer()   # bucketed grad application (25 MiB buckets)
+
+    @jax.jit
+    def fwd_bwd(p, x, y):
+        def loss(q):
+            return F.cross_entropy(model.apply(q, x), y)
+        return jax.value_and_grad(loss)(p)
+
+    traj_ch = ctx.channel("traj")
+    params_ch = ctx.channel("params")
+    params_ch.put_latest({"params": params, "step": 0, "stop": False})
+
+    losses = []
+    seen = {}   # actor role_rank -> set of incarnations whose batches we saw
+    t0 = None
+    with resilience.Heartbeat(rank=ctx.rank) as hb:
+        for step in range(args.max_steps):
+            while True:
+                try:
+                    msg = traj_ch.get(timeout=GET_TIMEOUT)
+                    break
+                except ChannelTimeoutError:
+                    # a skipped hole (actor killed mid-put) or a quiet
+                    # queue: retry claims the next message.  Dead-for-good
+                    # actors raise ChannelPeerGoneError out of the loop
+                    continue
+            if t0 is None:
+                t0 = time.monotonic()  # steady-state rate: skip compile
+            x, y = msg["x"], msg["y"]
+            l, g = fwd_bwd(params, x, y)
+            # bucketed grad application: leaves coalesce into flat buckets
+            # issued as async ring all-reduces over the learner's
+            # intra-role group (world 1 here — the same line scales to a
+            # multi-rank learner unchanged)
+            work = bucketer.all_reduce(jax.tree.map(np.asarray, g),
+                                       op="avg", group=ctx.group)
+            loss_now = float(l)          # overlaps the in-flight sync
+            g = work.wait_all(timeout=300)
+            params, opt_state = opt.update(g, opt_state, params)
+            losses.append(loss_now)
+            seen.setdefault(str(msg["actor"]), set()).add(
+                int(msg["incarnation"]))
+            hb.set_step(step)
+            if (step + 1) % args.publish_every == 0:
+                params_ch.put_latest({"params": params, "step": step + 1,
+                                      "stop": False})
+    dt = max(time.monotonic() - (t0 or time.monotonic()), 1e-9)
+    # stop protocol: a terminal register version, then close the consumer
+    # endpoint — an actor blocked in put() gets ChannelClosedError, one
+    # polling the register sees stop=True; both exit 0
+    params_ch.put_latest({"params": params, "step": args.max_steps,
+                          "stop": True})
+    traj_ch.close()
+    out = {"role": ctx.role, "pid": os.getpid(),
+           "generation": ctx.generation, "steps": len(losses),
+           "losses": losses,
+           "steps_per_sec": (len(losses) - 1) / dt if len(losses) > 1 else 0,
+           "seen_incarnations": {k: sorted(v) for k, v in seen.items()},
+           "traj_stats": dict(traj_ch.stats)}
+    with open(os.path.join(args.out, "learner.json"), "w") as f:
+        json.dump(out, f)
+    print(f"[actor_learner] learner done: {len(losses)} steps, "
+          f"loss {losses[0]:.4f} -> {losses[-1]:.4f}", flush=True)
+
+
+def run_actor(ctx, args):
+    import numpy as np
+
+    from tpu_dist import resilience
+    from tpu_dist.data import synthetic_mnist_arrays
+    from tpu_dist.resilience import chaos as chaos_mod
+    from tpu_dist.roles import ChannelClosedError
+
+    incarnation = int(os.environ.get("TPU_DIST_ROLE_INCARNATION", "0") or 0)
+    images, labels = synthetic_mnist_arrays(train=True, n=2048)
+    images = images.reshape(-1, 28, 28, 1).astype(np.float32) / 255.0
+    labels = labels.astype(np.int32)
+
+    traj_ch = ctx.channel("traj")
+    params_ch = ctx.channel("params")
+    out_path = os.path.join(
+        args.out, f"actor{ctx.role_rank}_i{incarnation}.json")
+
+    def write_out(produced):
+        with open(out_path, "w") as f:
+            json.dump({"role": f"{ctx.role}[{ctx.role_rank}]",
+                       "rank": ctx.rank, "pid": os.getpid(),
+                       "incarnation": incarnation,
+                       "generation": ctx.generation,
+                       "produced": produced}, f)
+
+    chaos = chaos_mod.active()
+    version, produced, counter = 0, 0, 0
+    with resilience.Heartbeat(rank=ctx.rank) as hb:
+        while True:
+            got = params_ch.poll_latest(version)
+            if got is not None:
+                snap, version = got
+                if snap.get("stop"):
+                    break
+            # a "trajectory": one seeded batch from the shared synthetic
+            # set (deterministic per (actor, counter) so reruns replay)
+            rng = np.random.default_rng(
+                10_000 * (ctx.role_rank + 1) + counter)
+            idx = rng.integers(0, len(images), size=args.batch_size)
+            try:
+                traj_ch.put({"x": images[idx], "y": labels[idx],
+                             "actor": ctx.role_rank, "counter": counter,
+                             "incarnation": incarnation},
+                            timeout=PUT_TIMEOUT)
+            except ChannelClosedError:
+                break   # learner finished and closed the consumer side
+            produced += 1
+            counter += 1
+            hb.set_step(counter)
+            if produced == 1 or produced % 16 == 0:
+                # write EARLY and often: a respawned incarnation proves
+                # "the channel resumed by name" with its first accepted put
+                write_out(produced)
+            # deterministic failure injection, FIRST incarnation only: the
+            # chaos spec simulates THIS incarnation's death; the respawned
+            # process must not replay it or the solo budget burns down on
+            # a loop (TPU_DIST_CHAOS counts per process)
+            if chaos is not None and incarnation == 0:
+                chaos.on_step(counter)
+            if args.actor_throttle > 0:
+                time.sleep(args.actor_throttle)
+    write_out(produced)
+    print(f"[actor_learner] actor[{ctx.role_rank}] i{incarnation} done: "
+          f"{produced} batches", flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--actors", type=int, default=4,
+                    help="actor count — must match the --roles spec")
+    ap.add_argument("--max-steps", type=int, default=100)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--publish-every", type=int, default=8,
+                    help="learner steps between parameter publications")
+    ap.add_argument("--actor-throttle", type=float, default=0.0,
+                    help="seconds an actor sleeps between batches (rate "
+                         "limiting for small test runs)")
+    ap.add_argument("--out", type=str, default="./actor_learner_out")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.makedirs(args.out, exist_ok=True)
+
+    from tpu_dist.roles import init_role_graph
+    with init_role_graph(build_graph(args.actors)) as ctx:
+        print(f"[actor_learner] rank {ctx.rank} = {ctx.role}"
+              f"[{ctx.role_rank}] (generation {ctx.generation})",
+              flush=True)
+        if ctx.role == "learner":
+            run_learner(ctx, args)
+        else:
+            run_actor(ctx, args)
+
+
+if __name__ == "__main__":
+    main()

@@ -318,6 +318,65 @@ def load(path):
 """
 
 
+# deadline-less channel ops on channel-named receivers (TD004 family for
+# tpu_dist.roles channels), and a ChannelSpec endpoint naming a role the
+# module's RoleGraph literal never declared
+TD010_POS = """
+g = RoleGraph([Role("learner", 1), Role("actor", 4)],
+              [ChannelSpec("traj", src="actor", dst="learner")])
+
+def loop(ctx):
+    ch = ctx.channel("traj")
+    ch.put({"x": 1})
+    return ch.get()
+"""
+
+TD010_NEG = """
+g = RoleGraph([Role("learner", 1), Role("actor", 4)],
+              [ChannelSpec("traj", src="actor", dst="learner")])
+
+def loop(ctx):
+    ch = ctx.channel("traj")
+    ch.put({"x": 1}, timeout=30)
+    ch.put_latest({"w": 1})          # a register write never blocks
+    d = {}
+    d.get("x")                       # non-channel receiver: not ours
+    return ch.get(timeout=30)
+"""
+
+# dangling endpoint vs the module's RoleGraph literal = error
+TD010_DANGLING_POS = """
+g = RoleGraph([Role("learner", 1), Role("actor", 4)],
+              [ChannelSpec("traj", src="actor", dst="leaner")])
+"""
+
+# dynamically-built role lists disable the endpoint check (cannot prove
+# absence), and the deadline check keys on receiver names only
+TD010_DYNAMIC_NEG = """
+def build(names):
+    return RoleGraph([Role(n, 1) for n in names],
+                     [ChannelSpec("c", src="a", dst="b")])
+"""
+
+# the direct Channel rig constructor names THIS endpoint's role at
+# (spec, store, rank, role, ...) — a literal absent from the RoleGraph
+# literal is the same dangling-endpoint error
+TD010_CHANNEL_ROLE_POS = """
+g = RoleGraph([Role("learner", 1), Role("actor", 4)],
+              [ChannelSpec("traj", src="actor", dst="learner")])
+spec = g.channel_spec("traj")
+ch = Channel(spec, store, 0, "lerner", src_span=[1], dst_span=[0])
+"""
+
+TD010_CHANNEL_ROLE_NEG = """
+g = RoleGraph([Role("learner", 1), Role("actor", 4)],
+              [ChannelSpec("traj", src="actor", dst="learner")])
+spec = g.channel_spec("traj")
+ch = Channel(spec, store, 0, "learner", src_span=[1], dst_span=[0])
+ch2 = Channel(spec, store, 1, role, src_span=[1], dst_span=[0])
+"""
+
+
 class TestRules:
     @pytest.mark.parametrize("rule,pos,neg", [
         ("TD001", TD001_POS, TD001_NEG),
@@ -329,6 +388,7 @@ class TestRules:
         ("TD007", TD007_POS, TD007_NEG),
         ("TD008", TD008_POS, TD008_NEG),
         ("TD009", TD009_POS, TD009_NEG),
+        ("TD010", TD010_POS, TD010_NEG),
     ])
     def test_positive_flags_negative_passes(self, rule, pos, neg):
         assert rule in _rules(lint_source(pos, f"{rule}_pos.py")), \
@@ -388,10 +448,32 @@ class TestRules:
         assert _rules(lint_source(TD009_RERAISE_NEG, "t.py")) == []
         assert _rules(lint_source(TD009_NARROW_NEG, "t.py")) == []
 
+    def test_td010_dangling_endpoint_is_error(self):
+        found = lint_source(TD010_DANGLING_POS, "t.py")
+        assert [(f.rule, f.severity) for f in found] == \
+            [("TD010", "error")]
+        assert "leaner" in found[0].message
+
+    def test_td010_deadline_form_is_warning(self):
+        found = [f for f in lint_source(TD010_POS, "t.py")
+                 if f.rule == "TD010"]
+        assert {f.severity for f in found} == {"warning"}
+        assert len(found) == 2  # the put and the get
+
+    def test_td010_dynamic_graph_disables_endpoint_check(self):
+        assert _rules(lint_source(TD010_DYNAMIC_NEG, "t.py")) == []
+
+    def test_td010_channel_role_literal(self):
+        found = lint_source(TD010_CHANNEL_ROLE_POS, "t.py")
+        assert [(f.rule, f.severity) for f in found] == \
+            [("TD010", "error")]
+        assert "lerner" in found[0].message
+        assert _rules(lint_source(TD010_CHANNEL_ROLE_NEG, "t.py")) == []
+
     def test_rule_docs_cover_all_codes(self):
         assert sorted(RULE_DOCS) == ["TD001", "TD002", "TD003", "TD004",
                                      "TD005", "TD006", "TD007", "TD008",
-                                     "TD009"]
+                                     "TD009", "TD010"]
 
     def test_td008_unguarded_group_collective_warns(self):
         found = lint_source(TD008_UNGUARDED_POS, "t.py")

@@ -17,6 +17,8 @@ TPU.  Currently shipped subpackages:
   ring/Ulysses sequence parallel, MoE expert-parallel rules
 - ``tpu_dist.checkpoint`` — atomic step-numbered save/restore (sharded ok)
 - ``tpu_dist.resilience`` — heartbeat watchdog, auto-resume, chaos faults
+- ``tpu_dist.roles`` — role-based process graphs (actor/learner, PS/worker)
+  with typed channels and per-role supervised restart (Launchpad-style)
 - ``tpu_dist.analysis`` — tpudlint static checker + runtime collective
   sanitizer (distributed-correctness tooling)
 - ``tpu_dist.obs`` — collective flight recorder, cross-rank trace
@@ -28,8 +30,8 @@ TPU.  Currently shipped subpackages:
 __version__ = "0.1.0"
 
 from . import (analysis, checkpoint, collectives, data, dist, interop,
-               models, nn, obs, optim, parallel, resilience, utils)
+               models, nn, obs, optim, parallel, resilience, roles, utils)
 
 __all__ = ["nn", "optim", "models", "dist", "collectives", "data",
-           "parallel", "checkpoint", "resilience", "utils", "interop",
-           "analysis", "obs", "__version__"]
+           "parallel", "checkpoint", "resilience", "roles", "utils",
+           "interop", "analysis", "obs", "__version__"]

@@ -39,6 +39,14 @@ deadlocking example per rule):
   ``CollectiveTimeoutError``) without re-raising or logging: the
   anti-pattern that turns the resilience layer's named diagnoses — and
   every injected netchaos fault — back into silent hangs.
+- **TD010** — role-graph channel hazards (tpu_dist.roles): a channel
+  ``put``/``get``/``get_latest`` on a channel-named receiver without a
+  timeout argument (warning — the TD004 family; channels do have an
+  internal default deadline, but loops should state their budget), or a
+  ``Channel``/``ChannelSpec`` whose literal ``src``/``dst`` names a role
+  absent from the module's ``RoleGraph`` literal (error — a dangling
+  endpoint raises ``RoleGraphError`` at runtime and can never carry a
+  message).
 - **TD007** — async collective ``Work`` handle dropped without ``wait()``:
   a bare-expression call with ``async_op=True`` (the handle is discarded
   on the spot), or a handle assigned to a name that is never used again.
@@ -874,6 +882,142 @@ def rule_td009(tree: ast.AST, path: str) -> List[Finding]:
     return out
 
 
+# -- TD010: role-graph channel hazards ----------------------------------------
+#
+# Two checks for tpu_dist.roles (docs/roles.md):
+#
+# (a) TD004-family deadline check on CHANNEL ops: `ch.get()` / `ch.put(x)`
+#     / `ch.get_latest(v)` without a timeout argument.  Channels do carry
+#     an internal default deadline (TPU_DIST_CH_TIMEOUT), so this is a
+#     warning, not an error — but a producer/consumer loop should state
+#     its budget explicitly, exactly like store waits.  Receiver-gated
+#     ("ch"/"chan"/"channel"-named receivers), because bare `get`/`put`
+#     are the most overloaded method names in Python (dict.get,
+#     queue.put) — same discipline as TD007's receiver gating.
+#
+# (b) a ChannelSpec whose literal src=/dst= role name — or a direct
+#     Channel rig constructor whose literal role= argument — is
+#     absent from the module's RoleGraph literal: the graph constructor
+#     raises at runtime (dangling endpoint), but only on the rank that
+#     builds it — statically it is always a bug.  Only enforced when the
+#     module's Role(...) literals are all string constants (a
+#     dynamically-built graph disables the check rather than guessing).
+
+_TD010_CHANNEL_EXACT = frozenset({"ch", "chan", "channel"})
+_TD010_CHANNEL_SUFFIXES = ("_ch", "_chan", "_channel")
+# blocking channel ops -> 0-based positional index at which a timeout may
+# legally arrive (put(value, timeout) / get(timeout) /
+# get_latest(last_version, timeout)); put_latest never blocks
+_TD010_BLOCKING = {"put": 1, "get": 0, "get_latest": 1}
+# endpoint-bearing callables -> (positional index, kwarg name) of their
+# role-name arguments: ChannelSpec names roles at (name, src, dst, ...);
+# Channel (the direct rig constructor) names THIS endpoint's role at
+# (spec, store, rank, role, ...)
+_TD010_ENDPOINT_CALLS = {
+    "ChannelSpec": ((1, "src"), (2, "dst")),
+    "Channel": ((3, "role"),),
+}
+
+
+def _channel_receiver(call: ast.Call) -> Optional[str]:
+    """The receiver name when it is channel-ish (``ch``/``traj_chan``/
+    ``params_channel``), else None."""
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    base = call.func.value
+    name = (base.id if isinstance(base, ast.Name)
+            else base.attr if isinstance(base, ast.Attribute) else None)
+    if name is None:
+        return None
+    low = name.lower()
+    if low in _TD010_CHANNEL_EXACT or low.endswith(_TD010_CHANNEL_SUFFIXES):
+        return name
+    return None
+
+
+def _role_literals(tree: ast.AST):
+    """``(names, complete)``: role names collected from ``Role(...)``
+    literals.  ``complete`` only when a ``RoleGraph(...)`` literal exists
+    and every ``Role`` first argument is a string constant — otherwise
+    the endpoint check stays off (we cannot prove a name is absent)."""
+    names = set()
+    any_graph = False
+    complete = True
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        t = _terminal_name(node.func)
+        if t == "RoleGraph":
+            any_graph = True
+        elif t == "Role":
+            arg = node.args[0] if node.args else next(
+                (kw.value for kw in node.keywords if kw.arg == "name"),
+                None)
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                names.add(arg.value)
+            else:
+                complete = False
+    return names, (any_graph and complete and bool(names))
+
+
+def _endpoint_roles(call: ast.Call, layout):
+    """``[(end, name_node)]`` for the literal role-name arguments of a
+    Channel/ChannelSpec call, per that callable's ``layout`` (positional
+    index, kwarg name)."""
+    out = []
+    for pos, end in layout:
+        node = None
+        if len(call.args) > pos:
+            node = call.args[pos]
+        else:
+            node = next((kw.value for kw in call.keywords
+                         if kw.arg == end), None)
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            out.append((end, node))
+    return out
+
+
+def rule_td010(tree: ast.AST, path: str) -> List[Finding]:
+    out: List[Finding] = []
+    role_names, complete = _role_literals(tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _terminal_name(node.func)
+        if name in _TD010_BLOCKING:
+            recv = _channel_receiver(node)
+            if recv is not None:
+                has = any(kw.arg in _TIMEOUT_KWARGS
+                          for kw in node.keywords) \
+                    or len(node.args) > _TD010_BLOCKING[name]
+                if not has:
+                    out.append(Finding(
+                        "TD010", "warning", path, node.lineno,
+                        node.col_offset,
+                        f"channel {recv}.{name}(...) without a "
+                        f"timeout/deadline argument: the internal "
+                        f"TPU_DIST_CH_TIMEOUT default applies, but a "
+                        f"role-graph producer/consumer loop should state "
+                        f"its budget explicitly (TD004 family) — a dead "
+                        f"peer role otherwise waits out the full default "
+                        f"before ChannelTimeoutError/"
+                        f"ChannelPeerGoneError names it"))
+        if name in _TD010_ENDPOINT_CALLS and complete:
+            for end, lit in _endpoint_roles(node,
+                                            _TD010_ENDPOINT_CALLS[name]):
+                if lit.value not in role_names:
+                    out.append(Finding(
+                        "TD010", "error", path, lit.lineno,
+                        lit.col_offset,
+                        f"channel endpoint {end}={lit.value!r} names no "
+                        f"role of this module's RoleGraph literal "
+                        f"(roles: {sorted(role_names)}): the graph "
+                        f"constructor raises RoleGraphError at runtime — "
+                        f"a dangling endpoint can never carry a message"))
+    out.sort(key=lambda f: (f.line, f.col))
+    return out
+
+
 # -- registry -----------------------------------------------------------------
 
 RULES = {
@@ -885,6 +1029,7 @@ RULES = {
     "TD007": rule_td007,
     "TD008": rule_td008,
     "TD009": rule_td009,
+    "TD010": rule_td010,
 }
 
 RULE_DOCS = {
@@ -904,6 +1049,10 @@ RULE_DOCS = {
     "TD009": "broad/bare except swallowing a named tpu_dist error class "
              "(PeerGoneError, RankLostError, CollectiveMismatchError, "
              "FrameCorruptError) without re-raising or logging",
+    "TD010": "role-graph channel hazards: deadline-less channel "
+             "put/get/get_latest (warning, TD004 family), or a "
+             "Channel/ChannelSpec endpoint naming a role absent from "
+             "the module's RoleGraph literal (error)",
 }
 
 

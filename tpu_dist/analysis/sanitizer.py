@@ -66,8 +66,15 @@ __all__ = ["CollectiveMismatchError", "enabled", "check_collective",
 # members, or a sub-group vs the flat world — would run incompatible rings
 # over colliding tags; the signature names BOTH memberships before any
 # payload moves.
+# "role" is the process's role-graph role (tpu_dist.roles), signed for
+# collectives on the FLAT world only: a collective that accidentally spans
+# two roles (a learner-side all_reduce reaching actor ranks through the
+# default group) then fails naming BOTH role names instead of a bare
+# membership deadline.  Deliberately-scoped cross-role SubGroups are
+# exempt — their identity is already signed via "group" — and role_rank
+# rides along as a diagnostic field (it legitimately differs per rank).
 SEMANTIC_FIELDS = ("op", "reduce", "tree", "leaves", "src", "dst", "comm",
-                   "group")
+                   "group", "role")
 
 # process-local sanitized-collective counters, one per signature scope:
 # every group (and the flat world) counts its own collectives, because a
@@ -135,6 +142,32 @@ def _call_site() -> str:
     (delegates to the shared attribution helper in tpu_dist.obs)."""
     from ..obs.recorder import call_site
     return call_site(skip_parts=("collectives", "analysis"))
+
+
+def _current_role():
+    """This process's ``(role, role_rank)`` under a role graph
+    (tpu_dist.roles), or None — consulted only on the armed path."""
+    try:
+        from ..roles.graph import current_role
+        return current_role()
+    except Exception:
+        return None
+
+
+def _role_notes(ranks) -> str:
+    """``" (roles: 2=actor[1], 3=actor[2])"`` for a rank list when a role
+    graph is installed — so a membership deadline names roles, not just
+    bare ranks.  Empty outside any graph."""
+    try:
+        from ..roles.graph import role_label
+        labels = [(r, role_label(r)) for r in ranks]
+        if any(lbl for _, lbl in labels):
+            return (" (roles: "
+                    + ", ".join(f"{r}={lbl or '?'}" for r, lbl in labels)
+                    + ")")
+    except Exception:
+        pass
+    return ""
 
 
 def _signature(op: str, rank: int, value: Any = None,
@@ -205,6 +238,13 @@ def check_collective(group, store, op: str, value: Any = None,
     mine = _signature(op, me, value=value, reduce_op=reduce_op, src=src,
                       dst=dst, comm=comm, with_leaves=with_leaves)
     mine["group"] = group_field
+    if getattr(group, "group_id", None) is None:
+        # flat-world collectives sign the caller's role (see the
+        # SEMANTIC_FIELDS note): inside a role graph, the default group
+        # spanning two roles is almost always the accident this catches
+        role = _current_role()
+        if role is not None:
+            mine["role"], mine["role_rank"] = role
     base = f"{_ns()}{scope}/{seq}"
     store.set(f"{base}/{me}", json.dumps(mine, sort_keys=True).encode())
 
@@ -221,7 +261,8 @@ def check_collective(group, store, op: str, value: Any = None,
             raise CollectiveMismatchError(
                 me, seq, op, mine["site"],
                 f"collective sanitizer: rank {me} announced collective "
-                f"#{seq} ({op} at {mine['site']}) but rank(s) {missing} "
+                f"#{seq} ({op} at {mine['site']}) but rank(s) "
+                f"{missing}{_role_notes(missing)} "
                 f"never announced theirs within {timeout:.0f}s "
                 f"(TPU_DIST_SANITIZE_TIMEOUT) — a rank-divergent "
                 f"collective: those ranks skipped this call or are blocked "

@@ -88,7 +88,16 @@ import numpy as np
 
 __all__ = ["DataPlane", "PeerGoneError", "FrameCorruptError",
            "CollectiveTimeoutError", "get_data_plane", "close_data_plane",
-           "frame_crc_enabled", "frame_checksum", "coll_timeout"]
+           "frame_crc_enabled", "frame_checksum", "coll_timeout",
+           "dp_addr_key"]
+
+
+def dp_addr_key(generation: int, rank: int) -> str:
+    """The store key under which ``rank`` publishes its data-plane
+    listener address — THE definition of the key contract; anything that
+    probes for a published address (e.g. roles channels deciding
+    store-vs-dataplane routing) must build the key here."""
+    return f"tpu_dist/g{generation}/dp/addr/{rank}"
 
 _MAGIC = b"TPDP"
 _HELLO = struct.Struct("<4sII")      # magic, rank, generation
@@ -512,7 +521,7 @@ class DataPlane:
     # -- addressing ----------------------------------------------------------
 
     def _addr_key(self, rank: int) -> str:
-        return f"tpu_dist/g{self.generation}/dp/addr/{rank}"
+        return dp_addr_key(self.generation, rank)
 
     def _host_key(self, rank: int) -> str:
         from .topology import host_key
@@ -1061,7 +1070,16 @@ class DataPlane:
         recorder is armed) with the peer's last posted position from the
         store — the dead rank cannot speak for itself, but its obs tail
         can.  Call OUTSIDE any transport lock: the lookup is a store
-        round-trip."""
+        round-trip.  Under a role graph (tpu_dist.roles) the peer is also
+        named by role — ``actor[2]`` says much more than ``rank 3``."""
+        try:
+            from ..roles.graph import role_label
+            label = role_label(peer)
+            if label:
+                detail = (f"{detail}; role {label}" if detail
+                          else f"role {label}")
+        except Exception:
+            pass
         try:
             from ..obs import hooks as _obs_hooks
             from ..obs import recorder as _obs_rec

@@ -170,6 +170,31 @@ def build_parser() -> argparse.ArgumentParser:
                    help="gateway's client-facing port (0 = ephemeral; the "
                         "bound address is published to the store under "
                         "tpu_dist/serve/gateway)")
+    p.add_argument("--roles", type=str, default=None,
+                   metavar="NAME:WORLD[:POLICY],...",
+                   help="launch a heterogeneous ROLE GRAPH instead of one "
+                        "SPMD world (tpu_dist.roles, docs/roles.md): e.g. "
+                        "'learner:1,actor:4:solo' spawns 5 ranks — rank 0 "
+                        "the learner, ranks 1-4 actors — each with "
+                        "TPU_DIST_ROLE/TPU_DIST_ROLE_RANK set and the "
+                        "role map published to the store.  POLICY is the "
+                        "per-role supervised-restart policy: 'solo' "
+                        "(a dead rank respawns alone, same generation — "
+                        "channels resume by name) or 'gang' (default: a "
+                        "death fails the round; --max_restarts budgets "
+                        "full relaunches).  Roles do not join a "
+                        "jax.distributed world — workers call "
+                        "tpu_dist.roles.init_role_graph() and talk "
+                        "through typed channels / intra-role sub-groups. "
+                        "Single-node; needs the control-plane store")
+    p.add_argument("--role_script", action="append", default=[],
+                   metavar="ROLE=SCRIPT",
+                   help="per-role entrypoint override for --roles "
+                        "(repeatable): ROLE's ranks run SCRIPT instead of "
+                        "the positional script")
+    p.add_argument("--solo_restarts", type=int, default=2,
+                   help="per-rank respawn budget for 'solo'-policy roles "
+                        "within one generation (--roles only)")
     p.add_argument("--standalone", action="store_true",
                    help="single-node mode with automatic rendezvous "
                         "(torchrun parity): forces --nnodes=1 "
@@ -291,12 +316,7 @@ def _spawn_world(args, world_size: int, master_port: int,
             if args.heartbeat_timeout > 0:
                 env["TPU_DIST_HEARTBEAT_TIMEOUT"] = str(
                     args.heartbeat_timeout)
-            if args.sanitize:
-                env["TPU_DIST_SANITIZE"] = "1"
-            if getattr(args, "coll_timeout", 0) > 0:
-                env["TPU_DIST_COLL_TIMEOUT"] = str(args.coll_timeout)
-            if getattr(args, "netchaos", None):
-                env["TPU_DIST_NETCHAOS"] = args.netchaos
+            env.update(_diagnostic_env(args))
             if getattr(args, "obs_dir", None):
                 env["TPU_DIST_OBS"] = "1"
                 env["TPU_DIST_OBS_DIR"] = args.obs_dir
@@ -321,21 +341,44 @@ def _spawn_world(args, world_size: int, master_port: int,
     return procs
 
 
+def _diagnostic_env(args) -> Dict[str, str]:
+    """The worker env for the opt-in diagnostic layers (sanitizer,
+    collective watchdog, netchaos) — ONE assembly shared by the SPMD
+    spawn path and the --roles path, so a new diagnostic knob cannot
+    silently apply to only one of them."""
+    env: Dict[str, str] = {}
+    if getattr(args, "sanitize", False):
+        env["TPU_DIST_SANITIZE"] = "1"
+    if getattr(args, "coll_timeout", 0) > 0:
+        env["TPU_DIST_COLL_TIMEOUT"] = str(args.coll_timeout)
+    if getattr(args, "netchaos", None):
+        env["TPU_DIST_NETCHAOS"] = args.netchaos
+    return env
+
+
 def _request_obs_dumps(args, procs: List[subprocess.Popen],
-                       remaining) -> None:
+                       remaining, rnd: int = 0) -> None:
     """Ask still-alive workers to flush their flight recorders (SIGUSR1 ->
-    tpu_dist.obs dump handler) before the TERM/KILL teardown.  Armed runs
-    only — a worker that never installed the handler would die on USR1,
-    which on this (already failed, about to be TERMed) path is harmless
-    but pointless."""
+    tpu_dist.obs dump handler) before the TERM/KILL teardown, then wait
+    (settle-bounded) for the dump files to land.  Armed runs only — a
+    worker that never installed the handler would die on USR1, which on
+    this (already failed, about to be TERMed) path is harmless but
+    pointless.
+
+    The settle wait (shared logic: ``obs.hooks.request_dumps``) exists
+    because the TERM that follows can be consumed at the C++ layer
+    (jax's preemption notifier owns SIGTERM) and kill the process before
+    the Python-level USR1 handler ever ran — the race behind
+    intermittently missing per-rank dumps."""
     if getattr(args, "obs_dir", None) is None:
         return
-    for j in remaining:
-        if procs[j].poll() is None:
-            try:
-                procs[j].send_signal(signal.SIGUSR1)
-            except OSError:
-                pass
+    from ..obs.hooks import request_dumps
+    from ..obs.recorder import dump_path
+
+    request_dumps(
+        (procs[j], dump_path(args.obs_dir, rnd,
+                             args.node_rank * args.nproc_per_node + j))
+        for j in remaining)
 
 
 def _watch_world(args, procs: List[subprocess.Popen], store,
@@ -455,7 +498,7 @@ def _watch_world(args, procs: List[subprocess.Popen], store,
             if (teardown_at is not None and not teardown_done
                     and time.monotonic() >= teardown_at):
                 teardown_done = True
-                _request_obs_dumps(args, procs, remaining)
+                _request_obs_dumps(args, procs, remaining, rnd)
                 for j in remaining:
                     procs[j].terminate()
                 kill_deadline = time.monotonic() + kill_grace
@@ -472,7 +515,7 @@ def _watch_world(args, procs: List[subprocess.Popen], store,
                         # it into a 117 is being shut down by us, not
                         # preempted (see pre_teardown_rcs above)
                         teardown_done = True
-                        _request_obs_dumps(args, procs, remaining)
+                        _request_obs_dumps(args, procs, remaining, rnd)
                         for j in remaining:
                             procs[j].terminate()
                         kill_deadline = time.monotonic() + kill_grace
@@ -497,7 +540,7 @@ def _watch_world(args, procs: List[subprocess.Popen], store,
                     # preemption — without this a hang would silently
                     # shrink the world instead of burning a restart
                     teardown_done = True
-                    _request_obs_dumps(args, procs, remaining)
+                    _request_obs_dumps(args, procs, remaining, rnd)
                     for j in remaining:
                         procs[j].terminate()
                     kill_deadline = time.monotonic() + kill_grace
@@ -769,6 +812,63 @@ def _elastic_agree(args, store, rnd: int, local_rc: int,
     return ("restart", rc_port)
 
 
+def _run_role_graph(args) -> int:
+    """``--roles``: launch a heterogeneous role graph (tpu_dist.roles)
+    instead of one SPMD world.  The graph supervisor
+    (:func:`tpu_dist.roles.spawn_graph`) owns the store, the role-map
+    publication, and per-role restart routing; this wrapper only
+    validates the CLI surface and assembles the worker env/argv."""
+    from ..roles import RoleGraphError, parse_roles_spec, spawn_graph
+
+    if args.nnodes > 1:
+        sys.stderr.write("--roles is single-node (--nnodes=1) for now: "
+                         "multi-node role placement needs a cross-launcher "
+                         "span agreement\n")
+        return 2
+    if args.no_store:
+        sys.stderr.write("--roles needs the control-plane store (role map, "
+                         "channels, liveness); drop --no_store\n")
+        return 2
+    if args.elastic_world:
+        sys.stderr.write("--roles and --elastic_world are mutually "
+                         "exclusive: per-role restart policy IS the "
+                         "elastic story for role graphs\n")
+        return 2
+    if args.max_restarts < 0 or args.solo_restarts < 0:
+        sys.stderr.write("restart budgets must be >= 0\n")
+        return 2
+    try:
+        graph = parse_roles_spec(args.roles)
+    except RoleGraphError as e:
+        sys.stderr.write(f"--roles: {e}\n")
+        return 2
+    argv = [sys.executable]
+    argv += ["-m", args.script] if args.module else [args.script]
+    argv += args.script_args
+    role_argv = {}
+    for spec in args.role_script:
+        name, _, script = spec.partition("=")
+        if not script:
+            sys.stderr.write(f"--role_script must be ROLE=SCRIPT, got "
+                             f"{spec!r}\n")
+            return 2
+        try:
+            graph.role(name)
+        except RoleGraphError as e:
+            sys.stderr.write(f"--role_script: {e}\n")
+            return 2
+        role_argv[name] = [sys.executable, script] + list(args.script_args)
+    extra_env = _diagnostic_env(args)
+    return spawn_graph(graph, argv, role_argv or None,
+                       max_restarts=args.max_restarts,
+                       solo_restarts=args.solo_restarts,
+                       heartbeat_timeout=args.heartbeat_timeout,
+                       restart_backoff=args.restart_backoff,
+                       master_addr=args.master_addr,
+                       store_port=args.store_port,
+                       extra_env=extra_env, obs_dir=args.obs_dir)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     if args.standalone:
@@ -837,6 +937,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.flight_recorder or _obs_enabled():
         args.obs_dir = (os.environ.get("TPU_DIST_OBS_DIR")
                         or os.path.join(os.getcwd(), "tpu_dist_obs"))
+
+    if args.roles:
+        return _run_role_graph(args)
 
     store, master_port, store_addr = _setup_store(args)
     if master_port is None:

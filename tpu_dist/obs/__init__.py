@@ -30,16 +30,16 @@ The standing observability surface for the eager/distributed stack
 from . import hooks, recorder, trace
 from .hooks import (collective_span, fetch_tail, install_from_env, note_path,
                     post_tail, render_tail)
-from .recorder import (FlightRecorder, default_dump_dir, dump_now, enabled,
-                       get_recorder, obs_key, record_transport, reset,
-                       reset_transport_counters, transport_counters)
+from .recorder import (FlightRecorder, default_dump_dir, dump_now, dump_path,
+                       enabled, get_recorder, obs_key, record_transport,
+                       reset, reset_transport_counters, transport_counters)
 from .trace import diagnose, merge_trace, read_dumps, render_diagnosis
 
 __all__ = [
     "recorder", "hooks", "trace",
     "FlightRecorder", "enabled", "get_recorder", "reset", "dump_now",
     "record_transport", "transport_counters", "reset_transport_counters",
-    "obs_key", "default_dump_dir",
+    "obs_key", "default_dump_dir", "dump_path",
     "collective_span", "note_path", "install_from_env", "post_tail",
     "fetch_tail", "render_tail",
     "read_dumps", "merge_trace", "diagnose", "render_diagnosis",

@@ -39,7 +39,8 @@ from . import recorder
 
 __all__ = ["collective_span", "current_span", "note_path", "note_algo",
            "annotate_transport", "heartbeat_tick", "post_tail", "fetch_tail",
-           "render_tail", "install_from_env", "install_signal_handlers"]
+           "render_tail", "install_from_env", "install_signal_handlers",
+           "request_dumps"]
 
 _tls = threading.local()
 
@@ -251,8 +252,10 @@ def render_tail(tail: dict) -> str:
     what = (f"collective #{tail['coll']} {op}"
             if tail.get("coll") is not None else f"{tail.get('kind', '?')} {op}")
     site = f" at {tail['site']}" if tail.get("site") else ""
+    role = f" role={tail['role']}" if tail.get("role") else ""
     return (f"{what} {tail.get('outcome', '?')}{site} "
-            f"(event #{tail.get('seq', '?')} of {tail.get('events', '?')})")
+            f"(event #{tail.get('seq', '?')} of {tail.get('events', '?')})"
+            f"{role}")
 
 
 def heartbeat_tick(store, step=None) -> None:
@@ -296,6 +299,56 @@ def _on_dump_signal(signum, frame):
     # dumps land even where SIGTERM is owned at the C++ level (XLA's
     # preemption notifier registers a raw sigaction Python cannot chain)
     recorder.dump_now(f"signal:{signum}")
+
+
+def request_dumps(targets, settle: Optional[float] = None) -> None:
+    """Supervisor-side dump flush: SIGUSR1 each still-running worker, then
+    wait (bounded) for its dump file to land before TERM goes out.
+
+    The settle wait exists because the TERM that follows can be consumed
+    at the C++ layer (jax's preemption notifier owns SIGTERM) and kill the
+    process before the Python-level USR1 handler ever ran — the race
+    behind intermittently missing per-rank dumps.  Bounded by ``settle`` /
+    ``TPU_DIST_OBS_DUMP_SETTLE`` (default 2 s) and skipped for ranks that
+    already exited; the dump write is atomic (tmp+rename), so a file that
+    exists is complete.
+
+    ``targets``: iterable of ``(proc, dump_path)`` pairs, ``proc`` a
+    ``subprocess.Popen`` (``poll()``/``send_signal()``).
+    """
+    def _mtime(path):
+        try:
+            return os.stat(path).st_mtime_ns
+        except OSError:
+            return None
+
+    signaled = []
+    for proc, path in targets:
+        if proc.poll() is None:
+            # snapshot BEFORE signaling: a previous incarnation's dump at
+            # the same path (solo respawns share generation + rank) must
+            # not satisfy the wait — we need a FRESH write, or the TERM
+            # that follows re-opens the very race this settle closes
+            signaled.append((proc, path, _mtime(path)))
+            try:
+                proc.send_signal(signal.SIGUSR1)
+            except OSError:
+                pass
+    if not signaled:
+        return
+    if settle is None:
+        try:
+            settle = float(
+                os.environ.get("TPU_DIST_OBS_DUMP_SETTLE", "2.0"))
+        except ValueError:
+            settle = 2.0
+    deadline = time.monotonic() + settle
+    while time.monotonic() < deadline:
+        if all(proc.poll() is not None
+               or (_mtime(path) is not None and _mtime(path) != before)
+               for proc, path, before in signaled):
+            return
+        time.sleep(0.05)
 
 
 def install_signal_handlers() -> None:

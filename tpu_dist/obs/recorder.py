@@ -41,7 +41,16 @@ import numpy as np
 
 __all__ = ["FlightRecorder", "enabled", "get_recorder", "reset", "dump_now",
            "record_transport", "transport_counters",
-           "reset_transport_counters", "obs_key", "default_dump_dir"]
+           "reset_transport_counters", "obs_key", "default_dump_dir",
+           "dump_path"]
+
+
+def dump_path(dir: str, generation: int, rank: int) -> str:
+    """Where rank ``rank``'s generation-``generation`` flight-recorder
+    dump lands — THE definition of the filename contract; anything that
+    waits on a dump file (the launchers' SIGUSR1 settle) must build the
+    path here."""
+    return os.path.join(dir, f"obs_g{generation}_r{rank}.json")
 
 # the armed values (same parser as the sanitizer's TPU_DIST_SANITIZE gate)
 _ON = ("1", "true", "yes", "on")
@@ -171,6 +180,16 @@ class FlightRecorder:
                       else int(os.environ.get("WORLD_SIZE", "1") or 1))
         self.generation = (generation if generation is not None
                            else _generation())
+        # role-graph identity (tpu_dist.roles): set from the launcher env
+        # here, corrected by init_role_graph — dumps, tails and the
+        # supervisor's positions table key on (role, role_rank) alongside
+        # the flat rank
+        self.role = os.environ.get("TPU_DIST_ROLE") or None
+        try:
+            self.role_rank = (int(os.environ["TPU_DIST_ROLE_RANK"])
+                              if self.role else None)
+        except (KeyError, ValueError):
+            self.role_rank = None
         self._buf: collections.deque = collections.deque(maxlen=self.capacity)
         self._open: Dict[int, dict] = {}
         # RLock, not Lock: the crash-dump signal handlers run ON the main
@@ -268,11 +287,14 @@ class FlightRecorder:
             last = self._last_coll or self._last
             if last is None:
                 return None
-            return {"rank": self.rank, "generation": self.generation,
-                    "seq": last["seq"], "kind": last["kind"],
-                    "op": last["op"], "coll": last.get("coll"),
-                    "site": last.get("site"), "outcome": last["outcome"],
-                    "events": self._seq}
+            pos = {"rank": self.rank, "generation": self.generation,
+                   "seq": last["seq"], "kind": last["kind"],
+                   "op": last["op"], "coll": last.get("coll"),
+                   "site": last.get("site"), "outcome": last["outcome"],
+                   "events": self._seq}
+            if self.role is not None:
+                pos["role"] = f"{self.role}[{self.role_rank}]"
+            return pos
 
     # -- dumps ---------------------------------------------------------------
 
@@ -281,9 +303,9 @@ class FlightRecorder:
         (atomic tmp+rename); returns the path."""
         out_dir = dir or default_dump_dir()
         os.makedirs(out_dir, exist_ok=True)
-        path = os.path.join(out_dir,
-                            f"obs_g{self.generation}_r{self.rank}.json")
+        path = dump_path(out_dir, self.generation, self.rank)
         doc = {"version": 1, "rank": self.rank, "world": self.world,
+               "role": self.role, "role_rank": self.role_rank,
                "generation": self.generation, "pid": os.getpid(),
                "reason": reason, "capacity": self.capacity,
                "wall_anchor_ns": self.wall_anchor_ns,

@@ -272,6 +272,16 @@ class HeartbeatMonitor:
         launcher saw its process exit 0) from staleness checks."""
         self._done.add(rank)
 
+    def reset_rank(self, rank: int) -> None:
+        """Forget a rank's staleness history — for supervisors that just
+        respawned it (role-graph solo restarts): the fresh incarnation
+        gets the startup grace again instead of inheriting the dead
+        incarnation's silence."""
+        now = time.monotonic()
+        self._state[rank] = (None, now)
+        self._step_state[rank] = (None, now)
+        self._done.discard(rank)
+
     def poll(self) -> List[RankLostError]:
         """One poll pass; returns the currently-lost ranks (possibly [])."""
         lost = []

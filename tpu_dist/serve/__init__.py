@@ -27,8 +27,8 @@ from .engine import (DeadlineExceededError, QueueFullError, Request,
                      RequestCancelledError, RequestHandle,
                      SchedulerClosedError, SchedulerDrainingError,
                      ServeError, SlotEngine)
-from .frontend import (BACKEND_KEY, GATEWAY_KEY, Frontend, Gateway,
-                       store_from_env)
+from .frontend import (BACKEND_KEY, GATEWAY_KEY, ROLE_FRONTEND,
+                       ROLE_MODEL_SHARD, Frontend, Gateway, store_from_env)
 from .scheduler import Scheduler
 
 __all__ = ["SlotEngine", "Scheduler", "Frontend", "Gateway", "ServeClient",
@@ -36,4 +36,5 @@ __all__ = ["SlotEngine", "Scheduler", "Frontend", "Gateway", "ServeClient",
            "SchedulerDrainingError", "SchedulerClosedError",
            "DeadlineExceededError", "RequestCancelledError",
            "RequestFailedError", "ServerGoneError",
-           "BACKEND_KEY", "GATEWAY_KEY", "store_from_env"]
+           "BACKEND_KEY", "GATEWAY_KEY", "ROLE_FRONTEND",
+           "ROLE_MODEL_SHARD", "store_from_env"]

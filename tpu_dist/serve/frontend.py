@@ -111,6 +111,16 @@ def _net_serve_fault(sock, payload: bytes) -> bytes:
 BACKEND_KEY = "tpu_dist/serve/backend"
 GATEWAY_KEY = "tpu_dist/serve/gateway"
 
+# Canonical role names for the multi-rank serving split under a role
+# graph (tpu_dist.roles, docs/roles.md): ``--roles frontend:1,
+# model-shard:N`` is the path to serving behind one frontend with N model
+# ranks — the frontend role runs the Gateway/Frontend pair, model-shard
+# ranks run SlotEngines with intra-role sub-group collectives.  Using
+# these constants keeps scripts, the role map and the sanitizer's role
+# signatures in agreement (docs/serving.md#roles).
+ROLE_FRONTEND = "frontend"
+ROLE_MODEL_SHARD = "model-shard"
+
 
 def send_frame(sock, obj: dict, lock: Optional[threading.Lock] = None) -> None:
     """One checksummed length-prefixed JSON frame, vectored send (header +
