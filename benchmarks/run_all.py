@@ -161,6 +161,7 @@ def main() -> None:
         "gen_latency_int8": "transformer_lm_decode_batch1_int8_tokens_per_sec",
         "gen_long_int8_cache": "transformer_lm_decode_long_context_int8_cache",
         "serve": "serve_continuous_batching_tokens_per_sec",
+        "serve_sharded": "serve_sharded_tokens_per_sec",
         "roles": "roles_channel_dp_best_mb_s",
     }
     import bench  # repo-root headline (MNIST ConvNet) — ratchet a copy here
@@ -184,6 +185,7 @@ def main() -> None:
                      ("gen_long_int8_cache",
                       generate.run_long_context_int8_cache),
                      ("serve", bench_serve.run),
+                     ("serve_sharded", bench_serve.run_sharded),
                      ("roles", bench_roles.run)):
         try:
             r = fn()

@@ -1,0 +1,246 @@
+"""Parameter-server training over a role graph — the MPMC-channel example
+at a larger world (ROADMAP: "a parameter-server example exercising MPMC
+channels at larger worlds").
+
+1 **server** + N **workers** (default 4), round-synchronous: each round,
+every worker pulls the freshest parameters off the versioned ``latest``
+register (BLOCKING for a strictly newer version — one gradient per
+worker per version), computes a gradient on its own deterministic batch,
+and pushes it over ONE bounded MPMC ``grads`` queue (4 producers → 1
+consumer; gradient trees above ``TPU_DIST_DP_THRESHOLD`` ride the p2p
+data plane as raw CRC'd frames, envelopes the sealed store path).  The
+server averages one round's gradients, applies Adam, and republishes —
+the version register IS the round barrier, so every applied gradient is
+exact-point (measured here: Adam stalls under even 2-update-stale
+gradients on this workload, so the async Downpour variant is a
+documented non-goal; the MPMC queue semantics are identical either
+way)::
+
+    python -m tpu_dist.launch --roles server:1,worker:4:solo \\
+        --max_restarts=1 examples/param_server.py --out ./ps_out
+
+Workers carry the ``solo`` restart policy: SIGKILL one mid-run
+(``TPU_DIST_CHAOS="kill:rank=2,step=3"``) and the supervisor respawns
+exactly that rank in the SAME generation — the server's round simply
+waits for the respawned worker's gradient (bounded by its get deadline),
+the next ``put`` lands on the same named queue (cursors live in the
+store), and training resumes.  A dead *server* fails the gang round
+instead (policy ``gang``): workers hold no state the graph can resume
+without it.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))  # run as a script without install
+
+GET_TIMEOUT = 120.0   # server's per-gradient budget
+PUT_TIMEOUT = 60.0    # worker's backpressure budget
+
+
+def build_graph(n_workers: int):
+    from tpu_dist.roles import ChannelSpec, Role, RoleGraph
+    return RoleGraph(
+        roles=[Role("server", 1),
+               Role("worker", n_workers, restart="solo")],
+        channels=[ChannelSpec("grads", src="worker", dst="server",
+                              depth=16),
+                  ChannelSpec("params", src="server", dst="worker",
+                              kind="latest")])
+
+
+def run_server(ctx, args):
+    import jax
+    import numpy as np
+
+    from tpu_dist import optim, resilience
+    from tpu_dist.models import ConvNet
+    from tpu_dist.roles import ChannelTimeoutError
+
+    model = ConvNet()
+    params = model.init(jax.random.PRNGKey(0))
+    opt = optim.Adam(lr=args.lr)
+    opt_state = opt.init(params)
+
+    grads_ch = ctx.channel("grads")
+    params_ch = ctx.channel("params")
+    params_ch.put_latest({"params": params, "version": 0, "stop": False})
+
+    losses = []
+    seen = {}   # worker role_rank -> incarnations whose gradients landed
+    version = 0
+    t0 = None
+    with resilience.Heartbeat(rank=ctx.rank) as hb:
+        for step in range(args.max_steps):
+            # one ROUND: one gradient per worker, all computed at the
+            # current version (the register is the barrier) — a killed
+            # worker's slot simply arrives after its solo respawn
+            round_grads = []
+            round_losses = []
+            while len(round_grads) < args.workers:
+                try:
+                    msg = grads_ch.get(timeout=GET_TIMEOUT)
+                except ChannelTimeoutError:
+                    # a skipped hole (worker killed mid-put) or a quiet
+                    # queue: retry claims the next gradient.  Dead-for-
+                    # good workers raise ChannelPeerGoneError out of here
+                    continue
+                if int(msg["version"]) != version:
+                    continue   # a pre-kill duplicate from an old round
+                round_grads.append(jax.tree.map(np.asarray, msg["grads"]))
+                round_losses.append(float(msg["loss"]))
+                seen.setdefault(str(msg["worker"]), set()).add(
+                    int(msg["incarnation"]))
+            if t0 is None:
+                t0 = time.monotonic()
+            g = jax.tree.map(lambda *xs: sum(xs) / len(xs), *round_grads)
+            params, opt_state = opt.update(g, opt_state, params)
+            version += 1
+            losses.append(sum(round_losses) / len(round_losses))
+            hb.set_step(step)
+            params_ch.put_latest({"params": params, "version": version,
+                                  "stop": False})
+    dt = max(time.monotonic() - (t0 or time.monotonic()), 1e-9)
+    # stop protocol: terminal register version, then close the consumer
+    # endpoint — a worker blocked in put() gets ChannelClosedError, one
+    # polling the register sees stop=True; both exit 0
+    params_ch.put_latest({"params": params, "version": version,
+                          "stop": True})
+    grads_ch.close()
+    out = {"role": ctx.role, "pid": os.getpid(),
+           "generation": ctx.generation, "steps": len(losses),
+           "losses": losses,
+           "steps_per_sec": (len(losses) - 1) / dt if len(losses) > 1
+           else 0,
+           "seen_incarnations": {k: sorted(v) for k, v in seen.items()},
+           "grads_stats": dict(grads_ch.stats)}
+    with open(os.path.join(args.out, "server.json"), "w") as f:
+        json.dump(out, f)
+    print(f"[param_server] server done: {len(losses)} rounds, "
+          f"loss {losses[0]:.4f} -> {losses[-1]:.4f}", flush=True)
+
+
+def run_worker(ctx, args):
+    import jax
+    import numpy as np
+
+    from tpu_dist import resilience
+    from tpu_dist.data import synthetic_mnist_arrays
+    from tpu_dist.models import ConvNet
+    from tpu_dist.nn import functional as F
+    from tpu_dist.resilience import chaos as chaos_mod
+    from tpu_dist.roles import ChannelClosedError
+
+    incarnation = int(os.environ.get("TPU_DIST_ROLE_INCARNATION", "0") or 0)
+    images, labels = synthetic_mnist_arrays(train=True, n=2048)
+    images = images.reshape(-1, 28, 28, 1).astype(np.float32) / 255.0
+    labels = labels.astype(np.int32)
+
+    model = ConvNet()
+
+    @jax.jit
+    def fwd_bwd(p, x, y):
+        def loss(q):
+            return F.cross_entropy(model.apply(q, x), y)
+        return jax.value_and_grad(loss)(p)
+
+    grads_ch = ctx.channel("grads")
+    params_ch = ctx.channel("params")
+    out_path = os.path.join(
+        args.out, f"worker{ctx.role_rank}_i{incarnation}.json")
+
+    def write_out(pushed):
+        with open(out_path, "w") as f:
+            json.dump({"role": f"{ctx.role}[{ctx.role_rank}]",
+                       "rank": ctx.rank, "pid": os.getpid(),
+                       "incarnation": incarnation,
+                       "generation": ctx.generation,
+                       "pushed": pushed}, f)
+
+    chaos = chaos_mod.active()
+    # the first pull BLOCKS for the server's initial publication — a
+    # worker must never push a gradient of uninitialized parameters.
+    # Each later round BLOCKS for a strictly newer version: exactly one
+    # gradient per (worker, version), so every applied gradient is
+    # exact-point.  A respawned incarnation re-reads the LATEST version
+    # and contributes to the round in progress.
+    from tpu_dist.roles import ChannelTimeoutError
+
+    version = 0
+    pushed, counter = 0, 0
+    with resilience.Heartbeat(rank=ctx.rank) as hb:
+        while True:
+            try:
+                snap, version = params_ch.get_latest(
+                    version, timeout=GET_TIMEOUT)
+            except ChannelTimeoutError:
+                continue   # quiet server (e.g. waiting on a respawn)
+            if snap.get("stop"):
+                break
+            params = snap["params"]
+            rng = np.random.default_rng(
+                50_000 * (ctx.role_rank + 1) + counter)
+            idx = rng.integers(0, len(images), size=args.batch_size)
+            l, g = fwd_bwd(params, images[idx], labels[idx])
+            try:
+                grads_ch.put({"grads": jax.tree.map(np.asarray, g),
+                              "loss": float(l),
+                              "version": int(snap.get("version", 0)),
+                              "worker": ctx.role_rank, "counter": counter,
+                              "incarnation": incarnation},
+                             timeout=PUT_TIMEOUT)
+            except ChannelClosedError:
+                break   # server finished and closed the consumer side
+            pushed += 1
+            counter += 1
+            hb.set_step(counter)
+            if pushed == 1 or pushed % 16 == 0:
+                # write EARLY and often: a respawned incarnation proves
+                # "the channel resumed by name" with its first accepted
+                # put
+                write_out(pushed)
+            # deterministic failure injection, FIRST incarnation only
+            # (the respawn must not replay the kill, or the solo budget
+            # burns down in a loop)
+            if chaos is not None and incarnation == 0:
+                chaos.on_step(counter)
+            if args.worker_throttle > 0:
+                time.sleep(args.worker_throttle)
+    write_out(pushed)
+    print(f"[param_server] worker[{ctx.role_rank}] i{incarnation} done: "
+          f"{pushed} gradients", flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workers", type=int, default=4,
+                    help="worker count — must match the --roles spec")
+    ap.add_argument("--max-steps", type=int, default=100,
+                    help="server-side update count")
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--worker-throttle", type=float, default=0.0,
+                    help="seconds a worker sleeps between gradients")
+    ap.add_argument("--out", type=str, default="./param_server_out")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.makedirs(args.out, exist_ok=True)
+
+    from tpu_dist.roles import init_role_graph
+    with init_role_graph(build_graph(args.workers)) as ctx:
+        print(f"[param_server] rank {ctx.rank} = {ctx.role}"
+              f"[{ctx.role_rank}] (generation {ctx.generation})",
+              flush=True)
+        if ctx.role == "server":
+            run_server(ctx, args)
+        else:
+            run_worker(ctx, args)
+
+
+if __name__ == "__main__":
+    main()

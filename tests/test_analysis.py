@@ -210,6 +210,23 @@ def handle_request(sched, serve_client, pool, client_pool, prompt, fn):
     return h.wait_done(30.0), g.wait_done(30.0)
 """
 
+# sharded-serving issuers (ISSUE 15): a shard/decoder receiver's
+# all_reduce with a truthy async_op returns a Work handle on the group's
+# ordered engine; the SYNC spelling returns the reduced array and must
+# NOT fire
+TD007_SHARD_POS = """
+def combine(shard_dec, part):
+    shard_dec.all_reduce(part, async_op=True)
+    w = decoder.all_reduce(part, async_op=True)
+"""
+
+TD007_SHARD_NEG = """
+def combine(shard_dec, part):
+    reduced = shard_dec.all_reduce(part)        # sync: returns the array
+    h = shard_dec.all_reduce(part, async_op=True)
+    return reduced, h.wait(30.0)
+"""
+
 # serve blocking waits: wait_done/drain take their deadline positionally
 TD004_SERVE_POS = """
 def consume(handle, sched):
@@ -223,6 +240,21 @@ def consume(handle, sched):
     toks = handle.wait_done(30.0)
     sched.drain(timeout=60.0)
     return toks
+"""
+
+# a follower's plan recv without a deadline would hang forever on a dead
+# shard leader (TD004 family, ISSUE 15)
+TD004_SHARD_POS = """
+def follow(follower):
+    plan = follower.recv_plan()
+    return plan
+"""
+
+TD004_SHARD_NEG = """
+def follow(follower):
+    plan = follower.recv_plan(30.0)
+    other = follower.recv_plan(timeout=30.0)
+    return plan, other
 """
 
 # serving service-discovery keys are documented cross-generation infra
@@ -540,6 +572,24 @@ class TestRules:
         assert _rules(found) == ["TD004", "TD004"]
         assert "wait_done" in found[0].message
         assert _rules(lint_source(TD004_SERVE_NEG, "t.py")) == []
+
+    def test_td007_shard_all_reduce_async_only(self):
+        # ISSUE 15: a shard/decoder receiver's all_reduce(async_op=True)
+        # returns a Work handle (bare drop = error, assigned-unused =
+        # warning); the sync spelling returns the reduced array
+        found = lint_source(TD007_SHARD_POS, "t.py")
+        assert _rules(found) == ["TD007", "TD007"]
+        assert found[0].severity == "error"      # bare-expression drop
+        assert found[1].severity == "warning"    # never-used handle
+        assert _rules(lint_source(TD007_SHARD_NEG, "t.py")) == []
+
+    def test_td004_shard_recv_plan_needs_deadline(self):
+        # a dead shard leader must surface as a named error, never a
+        # deadline-less hang in the follower's plan recv
+        found = lint_source(TD004_SHARD_POS, "t.py")
+        assert _rules(found) == ["TD004"]
+        assert "recv_plan" in found[0].message
+        assert _rules(lint_source(TD004_SHARD_NEG, "t.py")) == []
 
     def test_td003_serve_discovery_keys_allowlisted(self):
         # tpu_dist/serve/{backend,gateway} are cross-generation service

@@ -107,6 +107,9 @@ _WAIT_METHODS: Dict[str, int] = {
     "recv_array": 2,       # dp.recv_array(src, tag, timeout)
     "wait_done": 0,        # serve RequestHandle.wait_done(timeout)
     "drain": 0,            # serve Scheduler.drain(timeout)
+    "recv_plan": 0,        # serve ShardFollower.recv_plan(timeout): a
+                           # dead shard leader must surface as a named
+                           # PeerGoneError/TimeoutError, never a hang
 }
 _TIMEOUT_KWARGS = frozenset({"timeout", "deadline", "timeout_s"})
 
@@ -620,6 +623,16 @@ def _is_async_call(node: ast.AST) -> bool:
             and ("bucketer" in recv_name or "zopt" in recv_name
                  or "zero" in recv_name):
         return True
+    # sharded-serving partial combines: <shard/decoder>.all_reduce(part,
+    # async_op=True) returns a Work handle on the group's ordered engine
+    # (tpu_dist/serve/sharded.py); the SYNC form returns the reduced
+    # array, so only the truthy async_op spelling is a handle drop
+    if name == "all_reduce" and ("shard" in recv_name
+                                 or "decoder" in recv_name):
+        for kw in node.keywords:
+            if kw.arg == "async_op" and isinstance(kw.value, ast.Constant):
+                return bool(kw.value.value)
+        return False
     # .update() is ubiquitous (dict/set/Counter) — only receivers that
     # unambiguously name a ZeRO optimizer count, not any *zero* substring
     if name == "update" and ("zopt" in recv_name or "zeroopt" in recv_name

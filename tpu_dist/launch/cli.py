@@ -590,6 +590,20 @@ def _report_obs(args, store, world_size: int, rnd: int) -> None:
     rows = [(r, fetch_tail(store, rnd, r)) for r in range(world_size)]
     if all(t is None for _, t in rows):
         return  # recorder disarmed (or no tail made it): stay quiet
+    # role annotation from the published role map (tpu_dist.roles): serve
+    # ranks read "rank 1 (model-shard[1])", not a bare flat rank — works
+    # even when a rank's tail predates its role context (or a SIGKILLed
+    # rank posted none), because the map is the launcher-side truth
+    labels = {}
+    try:
+        from ..roles.graph import RoleGraph, map_key
+        key = map_key(rnd)
+        if store.check(key):
+            g = RoleGraph.from_json(store.get(key))
+            labels = {r: g.label(r) for r in range(min(world_size,
+                                                       g.world))}
+    except Exception:
+        labels = {}
     sys.stderr.write(f"[tpu_dist.launch] last known positions "
                      f"(generation {rnd}):\n")
     for r, tail in rows:
@@ -600,7 +614,8 @@ def _report_obs(args, store, world_size: int, rnd: int) -> None:
                 desc = render_tail(tail)
             except Exception:
                 desc = str(tail)
-        sys.stderr.write(f"  rank {r}: {desc}\n")
+        who = f"rank {r} ({labels[r]})" if r in labels else f"rank {r}"
+        sys.stderr.write(f"  {who}: {desc}\n")
 
 
 def _report_reshard_plan(store, new_world: int) -> None:
@@ -859,14 +874,51 @@ def _run_role_graph(args) -> int:
             return 2
         role_argv[name] = [sys.executable, script] + list(args.script_args)
     extra_env = _diagnostic_env(args)
-    return spawn_graph(graph, argv, role_argv or None,
-                       max_restarts=args.max_restarts,
-                       solo_restarts=args.solo_restarts,
-                       heartbeat_timeout=args.heartbeat_timeout,
-                       restart_backoff=args.restart_backoff,
-                       master_addr=args.master_addr,
-                       store_port=args.store_port,
-                       extra_env=extra_env, obs_dir=args.obs_dir)
+    store = None
+    gateway_proc = None
+    store_addr = None
+    if args.serve:
+        # the serving gateway rides OUTSIDE the graph's restart loop —
+        # like the SPMD path, its whole point is surviving gang rounds
+        # (it re-resolves the backend registry after each restart).  Host
+        # the store here so the gateway and spawn_graph share it.
+        from ..dist.store import TCPStore
+        try:
+            store = TCPStore(args.master_addr, args.store_port,
+                             is_master=True)
+        except Exception as e:
+            sys.stderr.write(f"--roles --serve: store setup failed "
+                             f"({e})\n")
+            return 2
+        store_addr = f"{args.master_addr}:{store.port}"
+        gw_env = dict(os.environ, TPU_DIST_STORE_ADDR=store_addr)
+        gateway_proc = subprocess.Popen(
+            [sys.executable, "-m", "tpu_dist.serve", "gateway",
+             "--port", str(args.serve_port)], env=gw_env)
+    try:
+        return spawn_graph(graph, argv, role_argv or None,
+                           max_restarts=args.max_restarts,
+                           solo_restarts=args.solo_restarts,
+                           heartbeat_timeout=args.heartbeat_timeout,
+                           restart_backoff=args.restart_backoff,
+                           master_addr=args.master_addr,
+                           store_port=args.store_port,
+                           store=store, store_addr=store_addr,
+                           extra_env=extra_env, obs_dir=args.obs_dir)
+    finally:
+        if gateway_proc is not None and gateway_proc.poll() is None:
+            gateway_proc.terminate()
+            try:
+                gateway_proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                gateway_proc.kill()
+                # tpudlint: disable=TD004  # reaping a SIGKILLed child
+                gateway_proc.wait()
+        if store is not None:
+            try:
+                store.close()
+            except Exception:
+                pass
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -120,7 +120,12 @@ class TransformerLM(nn.Module):
         self.ln_f = _norm_cls(norm)(dim)
         self.head = nn.Linear(dim, vocab_size)
 
-    def forward(self, idx, pos_offset=None):
+    def embed_tokens(self, idx, pos_offset=None):
+        """Token (+ learned positional) embeddings for ``idx`` (B, T) —
+        the input half of :meth:`forward`, factored out so the
+        tensor-parallel serving path (tpu_dist/serve/sharded.py) runs the
+        byte-identical embedding on every shard.  ``pos_offset`` may be a
+        scalar or a (B,) vector (per-slot decode positions)."""
         t = idx.shape[1]
         if pos_offset is None:
             if self.sequence_axis is not None:
@@ -134,10 +139,12 @@ class TransformerLM(nn.Module):
             # (B,) offsets index a (B, t) position table row per sequence
             pos_idx = (off[..., None] + jnp.arange(t) if off.ndim
                        else pos_offset + jnp.arange(t))
-            x = self.tok(idx) + self.pos(pos_idx)
-        else:
-            # rope: positions enter through the attention rotations
-            x = self.tok(idx)
+            return self.tok(idx) + self.pos(pos_idx)
+        # rope: positions enter through the attention rotations
+        return self.tok(idx)
+
+    def forward(self, idx, pos_offset=None):
+        x = self.embed_tokens(idx, pos_offset)
         # remat is a training-memory trade; during cached decode it must be
         # off — the attention layers' put_state writes would leak tracers
         # out of the jax.checkpoint sub-trace (and inference keeps no

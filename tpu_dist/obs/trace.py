@@ -163,10 +163,13 @@ def diagnose(dumps: List[dict]) -> dict:
     # span per request with its queue/prefill/decode split)
     stuck_requests = []
     for dmp in dumps:
+        role = (f"{dmp['role']}[{dmp.get('role_rank')}]"
+                if dmp.get("role") else None)
         for e in dmp.get("events", []):
             if e.get("kind") == "serve" and e.get("outcome") == "pending":
                 stuck_requests.append({
-                    "rank": dmp.get("rank", 0), "req": e.get("req"),
+                    "rank": dmp.get("rank", 0), "role": role,
+                    "req": e.get("req"),
                     "phase": ("decode" if e.get("slot") is not None
                               else "queued"),
                     "slot": e.get("slot"),
@@ -256,8 +259,10 @@ def render_diagnosis(d: dict) -> str:
         lines.append(f"  WARNING: no dump from rank(s) {d['missing_ranks']} "
                      f"(world {d.get('world')})")
     for sr in d.get("stuck_requests", []):
+        who = (f"rank {sr['rank']} ({sr['role']})" if sr.get("role")
+               else f"rank {sr['rank']}")
         lines.append(
-            f"  stuck request: rank {sr['rank']} req {sr['req']} "
+            f"  stuck request: {who} req {sr['req']} "
             f"({sr['phase']}"
             + (f", slot {sr['slot']}" if sr.get("slot") is not None else "")
             + (f", prompt {sr['prompt_len']} tokens"

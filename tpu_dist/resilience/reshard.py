@@ -401,9 +401,16 @@ class _ShardReader:
 
     def __init__(self, root: str, old_rank: int, step: int):
         from .. import checkpoint
-        self.old_rank = old_rank
-        self._dir = os.path.join(checkpoint.shard_root(root, old_rank),
-                                 f"step_{step:08d}")
+        self._setup(os.path.join(checkpoint.shard_root(root, old_rank),
+                                 f"step_{step:08d}"), old_rank)
+
+    def _setup(self, step_dir: str, label) -> None:
+        """The ONE init body (both constructors share it, so a field
+        added here can never be missing from ``from_dir`` readers).
+        ``label`` is the old rank — or a descriptive string for
+        non-shard-root readers — used in diagnostics."""
+        self.old_rank = label
+        self._dir = os.fspath(step_dir)
         self.path = os.path.join(self._dir, "arrays.npz")
         self._zf: Optional[zipfile.ZipFile] = None
         self._raw = None
@@ -413,6 +420,18 @@ class _ShardReader:
         # engine's pushes; seeks and reads on the shared file handle must
         # not interleave (RLock: read_range nests _member_layout)
         self._mu = threading.RLock()
+
+    @classmethod
+    def from_dir(cls, step_dir: str, label: str = "checkpoint"
+                 ) -> "_ShardReader":
+        """A reader over an arbitrary checkpoint step directory (not a
+        per-rank ZeRO shard root) — the fragment range-read machinery
+        applied to FULL checkpoints, e.g. loading a whole-model save
+        directly into tensor-parallel shard layouts
+        (``tpu_dist.serve.sharded.ShardedParams.from_checkpoint``)."""
+        self = cls.__new__(cls)
+        self._setup(step_dir, label)
+        return self
 
     def frag_digest(self, path: str, leaf_pos: int) -> Optional[str]:
         """The sha256 THIS old rank's checkpoint recorded for member leaf

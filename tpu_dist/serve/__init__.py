@@ -26,15 +26,25 @@ from .client import RequestFailedError, ServeClient, ServerGoneError
 from .engine import (DeadlineExceededError, QueueFullError, Request,
                      RequestCancelledError, RequestHandle,
                      SchedulerClosedError, SchedulerDrainingError,
-                     ServeError, SlotEngine)
-from .frontend import (BACKEND_KEY, GATEWAY_KEY, ROLE_FRONTEND,
-                       ROLE_MODEL_SHARD, Frontend, Gateway, store_from_env)
+                     ServeError, SlotEngine, sample_tokens)
+from .frontend import (BACKEND_KEY, BACKENDS_REG_PREFIX, BACKENDS_SEQ_KEY,
+                       GATEWAY_KEY, ROLE_FRONTEND, ROLE_MODEL_SHARD,
+                       Frontend, Gateway, list_backends, register_backend,
+                       store_from_env)
 from .scheduler import Scheduler
+from .sharded import (ShardConfigError, ShardedDecoder, ShardedLM,
+                      ShardedParams, ShardedSlotEngine, ShardFollower,
+                      ShardPlanError, shard_params)
 
 __all__ = ["SlotEngine", "Scheduler", "Frontend", "Gateway", "ServeClient",
            "Request", "RequestHandle", "ServeError", "QueueFullError",
            "SchedulerDrainingError", "SchedulerClosedError",
            "DeadlineExceededError", "RequestCancelledError",
-           "RequestFailedError", "ServerGoneError",
-           "BACKEND_KEY", "GATEWAY_KEY", "ROLE_FRONTEND",
-           "ROLE_MODEL_SHARD", "store_from_env"]
+           "RequestFailedError", "ServerGoneError", "sample_tokens",
+           "BACKEND_KEY", "GATEWAY_KEY", "BACKENDS_SEQ_KEY",
+           "BACKENDS_REG_PREFIX", "ROLE_FRONTEND",
+           "ROLE_MODEL_SHARD", "store_from_env",
+           "register_backend", "list_backends",
+           "ShardedLM", "ShardedDecoder", "ShardedSlotEngine",
+           "ShardFollower", "ShardedParams", "shard_params",
+           "ShardConfigError", "ShardPlanError"]

@@ -71,6 +71,7 @@ class ServeClient:
         self._send_mu = threading.Lock()
         self._mu = threading.Lock()
         self._handles: Dict[int, RequestHandle] = {}
+        self._stats_waiters: Dict[int, object] = {}
         self._next_id = 1
         self._closed = False
         self._reader = threading.Thread(target=self._read_loop, daemon=True,
@@ -126,6 +127,45 @@ class ServeClient:
         """Blocking convenience: submit and wait for the full token list."""
         return self.submit(prompt, max_new_tokens, **kw).wait_done(timeout)
 
+    def stats(self, timeout: float = 10.0) -> dict:
+        """Server-side load snapshot, one ``stats`` frame round-trip.
+        Against a :class:`~tpu_dist.serve.frontend.Frontend`: the engine's
+        occupancy/latency split + the scheduler's queue depth.  Against a
+        :class:`~tpu_dist.serve.frontend.Gateway`: per-backend in-flight
+        counts (the routing balance) under ``"gateway"`` plus each live
+        backend's own stats under ``"backends"`` — what the sharded bench
+        reads instead of parsing obs dumps.  Deadline-bounded."""
+        import queue as _queue
+
+        with self._mu:
+            if self._closed:
+                raise ServerGoneError("client is closed")
+            rid = self._next_id
+            self._next_id += 1
+            box: "_queue.Queue" = _queue.Queue(1)
+            self._stats_waiters[rid] = box
+        try:
+            send_frame(self._sock, {"type": "stats", "id": rid},
+                       lock=self._send_mu)
+        except (OSError, ConnectionError) as e:
+            with self._mu:
+                self._stats_waiters.pop(rid, None)
+            self._fail_all(ServerGoneError(
+                f"connection to {self.host}:{self.port} lost: {e!r}"))
+            raise self._handles_error()
+        try:
+            got = box.get(timeout=timeout)
+        except _queue.Empty:
+            raise TimeoutError(
+                f"no stats frame from {self.host}:{self.port} within "
+                f"{timeout:.1f}s") from None
+        finally:
+            with self._mu:
+                self._stats_waiters.pop(rid, None)
+        if isinstance(got, BaseException):
+            raise got
+        return got
+
     def pending(self) -> int:
         with self._mu:
             return len(self._handles)
@@ -160,8 +200,15 @@ class ServeClient:
         with self._mu:
             self._closed = True
             handles, self._handles = list(self._handles.values()), {}
+            waiters = list(self._stats_waiters.values())
+            self._stats_waiters.clear()
         for h in handles:
             h._on_error(exc)
+        for box in waiters:
+            try:
+                box.put_nowait(exc)   # a blocked stats() call terminates
+            except Exception:
+                pass
 
     def _read_loop(self) -> None:
         detail = "server closed the connection"
@@ -184,6 +231,15 @@ class ServeClient:
     def _dispatch(self, frame: dict) -> None:
         kind = frame.get("type")
         rid = frame.get("id")
+        if kind == "stats":
+            with self._mu:
+                box = self._stats_waiters.get(rid)
+            if box is not None:
+                try:
+                    box.put_nowait(frame.get("stats") or {})
+                except Exception:
+                    pass
+            return
         with self._mu:
             handle = self._handles.get(rid)
             if kind in ("done", "error") and rid in self._handles:

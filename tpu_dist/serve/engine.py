@@ -46,7 +46,7 @@ from ..utils.metrics import LatencyHistogram
 __all__ = ["SlotEngine", "Request", "RequestHandle", "ServeError",
            "QueueFullError", "SchedulerDrainingError",
            "SchedulerClosedError", "DeadlineExceededError",
-           "RequestCancelledError", "error_outcome"]
+           "RequestCancelledError", "error_outcome", "sample_tokens"]
 
 
 class ServeError(RuntimeError):
@@ -265,6 +265,36 @@ class Request:
             self.on_error(self, exc)
 
 
+def sample_tokens(logits, temps, keys, steps, sampling: bool):
+    """Per-slot next token from (B, vocab) logits: greedy argmax at
+    temperature 0 (the parity mode the smoke gate cross-checks against
+    ``generate``), categorical at temperature > 0 with a per-request key
+    folded by step — the same ``fold_in(key, step)`` schedule ``generate``
+    uses, so a single-request engine run with the same key reproduces it.
+    ``sampling`` is a static flag: the all-greedy pool (the common case)
+    compiles without the sampling branch at all.
+
+    Module-level (traced) so the single-rank :class:`SlotEngine` and the
+    tensor-parallel shards (tpu_dist/serve/sharded.py) run the SAME
+    sampling math — every shard computes the identical next token from
+    the identical post-all-reduce logits, which is what lets followers
+    stay in lockstep without a per-step token broadcast."""
+    import jax
+    import jax.numpy as jnp
+
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if not sampling:
+        return greedy
+
+    def one(key, step, row, temp):
+        return jax.random.categorical(
+            jax.random.fold_in(key, step),
+            row / jnp.maximum(temp, 1e-6))
+
+    sampled = jax.vmap(one)(keys, steps, logits, temps)
+    return jnp.where(temps > 0, sampled.astype(jnp.int32), greedy)
+
+
 def _bucket_lengths(max_prompt: int, min_bucket: int = 16) -> List[int]:
     """Power-of-two padded-prompt lengths up to ``max_prompt`` (always
     includes ``max_prompt`` itself): one compiled prefill per bucket."""
@@ -327,18 +357,33 @@ class SlotEngine:
         self._occupied_slot_steps = 0
         self._decode_steps = 0
 
+        self._build_programs()
+
+    def _build_programs(self) -> None:
+        """Compile the two pool programs (``self._decode`` /
+        ``self._prefill``).  The tensor-parallel engine
+        (:class:`tpu_dist.serve.sharded.ShardedSlotEngine`) overrides this
+        ONE hook to substitute its per-shard segment pipeline — every
+        other line of slot bookkeeping is shared, so the two engines
+        cannot drift on admission/finish semantics."""
+        import jax
+        import jax.numpy as jnp
+
+        model = self.model
+
         def _decode_fn(params, cache, tokens, lengths, temps, keys, steps,
                        sampling):
             logits, cache = model.decode_step(params, tokens, lengths,
                                               cache)
-            return self._sample(logits, temps, keys, steps, sampling), cache
+            return sample_tokens(logits, temps, keys, steps,
+                                 sampling), cache
 
         def _prefill_fn(params, cache, prompt, length, slot, temp, key,
                         sampling):
             logits, cache = model.prefill_into_slot(params, prompt, length,
                                                     slot, cache)
-            tok = self._sample(logits[None], temp[None], key[None],
-                               jnp.zeros((1,), jnp.int32), sampling)
+            tok = sample_tokens(logits[None], temp[None], key[None],
+                                jnp.zeros((1,), jnp.int32), sampling)
             return tok[0], cache
 
         # the cache is donated (the pool buffer is updated in place instead
@@ -353,29 +398,18 @@ class SlotEngine:
     # -- sampling (traced) ---------------------------------------------------
 
     def _sample(self, logits, temps, keys, steps, sampling: bool):
-        """Per-slot next token: greedy argmax at temperature 0 (the parity
-        mode the smoke gate cross-checks against ``generate``), categorical
-        at temperature > 0 with a per-request key folded by step — the same
-        ``fold_in(key, step)`` schedule ``generate`` uses, so a
-        single-request engine run with the same key reproduces it.
-        ``sampling`` is a static flag: the all-greedy pool (the common
-        case) compiles without the sampling branch at all."""
-        import jax
-        import jax.numpy as jnp
-
-        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        if not sampling:
-            return greedy
-
-        def one(key, step, row, temp):
-            return jax.random.categorical(
-                jax.random.fold_in(key, step),
-                row / jnp.maximum(temp, 1e-6))
-
-        sampled = jax.vmap(one)(keys, steps, logits, temps)
-        return jnp.where(temps > 0, sampled.astype(jnp.int32), greedy)
+        """Back-compat shim over the module-level :func:`sample_tokens`."""
+        return sample_tokens(logits, temps, keys, steps, sampling)
 
     # -- introspection -------------------------------------------------------
+
+    @property
+    def fatal_error(self):
+        """Non-None when the engine is unusable as a whole (not just one
+        request) — the scheduler checks it after an admit failure and
+        shuts down with this cause instead of serving a dead pool.  The
+        sharded engine reports its poisoned-lockstep state here."""
+        return None
 
     def free_slots(self) -> int:
         return int(self.num_slots - self.active.sum())
@@ -431,6 +465,15 @@ class SlotEngine:
         free (callers check :meth:`free_slots` first).  Cancelled or
         past-deadline requests are refused by name BEFORE the prefill —
         shedding stale load instead of spending a compiled program on it."""
+        slot = self._admission_slot(req)
+        self._pre_admit(req, slot)
+        return self._admit(req, slot)
+
+    def _admission_slot(self, req: Request) -> int:
+        """All admission pre-checks + the deterministic slot choice (lowest
+        free index).  Split from :meth:`_admit` so the sharded engine can
+        broadcast its admission plan AFTER every refusal path has passed —
+        a follower must never prefill a slot the leader then refuses."""
         if req.cancelled:
             raise RequestCancelledError(
                 f"request {req.id} was cancelled before admission")
@@ -441,8 +484,16 @@ class SlotEngine:
         free = np.flatnonzero(~self.active)
         if len(free) == 0:
             raise RuntimeError("no free slot (check free_slots() first)")
-        slot = int(free[0])
         self.validate(len(req.prompt), req.max_new_tokens)
+        return int(free[0])
+
+    def _pre_admit(self, req: Request, slot: int) -> None:
+        """Hook between the (passed) admission checks and the prefill —
+        the sharded engine's plan broadcast point."""
+
+    def _admit(self, req: Request, slot: int) -> int:
+        """The unconditional admission half: prefill + slot bookkeeping
+        (every refusal already ruled out by :meth:`_admission_slot`)."""
         req.t_admit = _now()
         self.hist_queue.observe(req.t_admit - req.t_submit)
         staged = req.staged if req.staged is not None else self.stage(req)
@@ -541,7 +592,20 @@ class SlotEngine:
         decoding to ``max_new_tokens`` for nobody.  The request terminates
         with the named error and its obs span closes ``error:Cancelled`` /
         ``error:DeadlineExceededError``.  Returns the slots freed."""
-        n = 0
+        expired = self._sweep_candidates()
+        if expired:
+            self._pre_free([slot for slot, _ in expired])
+        for slot, exc in expired:
+            self.fail_slot(slot, exc)
+        return len(expired)
+
+    def _sweep_candidates(self) -> List[tuple]:
+        """``(slot, named_error)`` for every active slot whose request was
+        cancelled or ran past its deadline — the decision half of
+        :meth:`sweep_expired`, taken on the LEADER's clock only (the
+        sharded engine broadcasts the resulting slot list so followers
+        free the same slots without consulting their own clocks)."""
+        out = []
         now = _now()
         for slot in np.flatnonzero(self.active):
             slot = int(slot)
@@ -549,18 +613,20 @@ class SlotEngine:
             if req is None:
                 continue
             if req.cancelled:
-                self.fail_slot(slot, RequestCancelledError(
+                out.append((slot, RequestCancelledError(
                     f"request {req.id} cancelled after {req.emitted} "
                     f"token(s); slot {slot} freed at the iteration "
-                    f"boundary"))
-                n += 1
+                    f"boundary")))
             elif req.expired(now):
-                self.fail_slot(slot, DeadlineExceededError(
+                out.append((slot, DeadlineExceededError(
                     f"request {req.id} exceeded its deadline_ms after "
                     f"{req.emitted} token(s); slot {slot} freed at the "
-                    f"iteration boundary"))
-                n += 1
-        return n
+                    f"iteration boundary")))
+        return out
+
+    def _pre_free(self, slots: List[int]) -> None:
+        """Hook before a sweep frees ``slots`` — the sharded engine's
+        free-plan broadcast point."""
 
     def _free(self, slot: int) -> None:
         self.active[slot] = False

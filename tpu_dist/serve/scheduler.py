@@ -185,6 +185,24 @@ class Scheduler:
         return self._draining.is_set()
 
     @property
+    def fatal(self) -> Optional[BaseException]:
+        """The error that killed the decode loop, or None while healthy.
+        A serving worker polls this: a fatal engine death (a shard peer
+        SIGKILLed mid-collective surfaces here as the leader's
+        ``PeerGoneError``) must turn into a nonzero exit so the supervisor
+        gang-restarts the shard group instead of leaving a zombie frontend
+        refusing every submit."""
+        return self._fatal
+
+    def snapshot(self) -> dict:
+        """Queue-side load counters for the wire ``stats`` frame (engine
+        aggregates ride :meth:`SlotEngine.stats`)."""
+        return {"pending": self._pending.qsize(),
+                "staged": self._staged.qsize(),
+                "draining": self._draining.is_set(),
+                "steps": self._steps}
+
+    @property
     def steps(self) -> int:
         """Decode iterations run so far (heartbeat progress feed)."""
         return self._steps
@@ -308,6 +326,15 @@ class Scheduler:
         except Exception as e:   # a bad request must not kill the loop
             self.engine._obs_end(req, error_outcome(e))
             req.fail(e)
+            fatal = getattr(self.engine, "fatal_error", None)
+            if fatal is not None:
+                # the failure poisoned the ENGINE, not just the request
+                # (a sharded leader whose admit plan was broadcast before
+                # its prefill died): shut down with the cause — the loop
+                # epilogue fails everything by name, exactly like a
+                # fatal step()
+                self._fatal = fatal
+                self._stop.set()
         finally:
             self._staged.task_done()
 
