@@ -154,6 +154,46 @@ def _worker() -> int:
         algo = case.get("algo", "flat")
         x = (np.random.default_rng(1000 + rank)
              .standard_normal(nbytes // 4).astype(np.float32))
+        if case.get("crc_paired"):
+            # the CRC gate is PAIRED: each rep times the checksum-armed
+            # arm and the disarmed arm back-to-back on the same emulated
+            # wire, so a suite-load spike lands on both arms of its pair
+            # and cancels in the ratio; the median per-pair overhead is
+            # what the tier-1 gate asserts.  (The former best-of-N
+            # per-arm comparison ran the arms seconds apart and drifted
+            # with background load — the retried tier-1 flake.)
+            reps = max(1, int(case.get("reps", 1)))
+            apply_case_env(dict(case, crc="1"))
+            run_op(op, x)  # warm-up: opens peer connections
+            arm_t = {"1": [], "0": []}
+            for rep in range(reps):
+                # ABBA order: whichever arm runs second in a pair starts
+                # with warmer caches/sockets; alternating cancels that
+                # systematic edge across pairs instead of baking it in
+                order = ("1", "0") if rep % 2 == 0 else ("0", "1")
+                for crc in order:
+                    apply_case_env(dict(case, crc=crc))
+                    store.barrier(world, tag=f"crcp/{ci}/{rep}/{crc}")
+                    t0 = time.perf_counter()
+                    for _ in range(iters):
+                        run_op(op, x)
+                    arm_t[crc].append(time.perf_counter() - t0)
+            pair_pcts = sorted((on - off) / off * 100.0
+                               for on, off in zip(arm_t["1"], arm_t["0"]))
+            mid = len(pair_pcts) // 2
+            med = (pair_pcts[mid] if len(pair_pcts) % 2
+                   else (pair_pcts[mid - 1] + pair_pcts[mid]) / 2)
+            rows.append({
+                "metric": "crc_paired", "op": op, "world": world,
+                "bytes": nbytes, "iters": iters, "pairs": reps,
+                "wire_mb_s": case.get("wire_rate", 0) // 1_000_000,
+                "value": round(max(0.0, med), 2), "unit": "%",
+                "pair_pcts": [round(p, 2) for p in pair_pcts],
+                "on_mb_s": round(nbytes * iters / min(arm_t["1"]) / 1e6,
+                                 2),
+                "off_mb_s": round(nbytes * iters / min(arm_t["0"]) / 1e6,
+                                  2)})
+            continue
         apply_case_env(case)
         out = run_op(op, x)  # warm-up: opens peer connections, primes numpy
         if spec.get("check") and op == "all_reduce" \
@@ -215,9 +255,6 @@ def _worker() -> int:
                "world": world, "bytes": nbytes, "iters": iters,
                "reps": reps, "comm": comm or "f32", "algo": algo,
                "value": round(best, 2), "unit": "MB/s"}
-        if case.get("crc") is not None:
-            row["crc"] = case["crc"]
-            row["wire_mb_s"] = case.get("wire_rate", 0) // 1_000_000
         if counters:
             row["compression"] = round(counters["compression"], 2)
         rows.append(row)
@@ -282,17 +319,16 @@ def _run_world(world: int, sizes, iters_override, check: bool,
               for algo in ("flat_shm", "hier")]
     # frame-integrity (CRC) overhead isolate at the 8 MiB gate size: the
     # SAME flat dataplane all-reduce with checksums armed (the default)
-    # vs disarmed, best-of-N max-MB/s each (the bench_obs_overhead
-    # anti-noise discipline), both arms paced to an identical emulated
-    # wire rate (netchaos slow-drip — see apply_case_env) so the gate
-    # measures integrity's cost in the wire-bound regime the data plane
-    # deploys into.  The crc_overhead summary is gated < 5% in the
-    # tier-1 --smoke run.
+    # vs disarmed, measured PAIRED (each rep times both arms back to
+    # back; the worker reports the median per-pair overhead), both arms
+    # paced to an identical emulated wire rate (netchaos slow-drip — see
+    # apply_case_env) so the gate measures integrity's cost in the
+    # wire-bound regime the data plane deploys into.  The crc_overhead
+    # summary is gated < 5% in the tier-1 --smoke run.
     cases += [{"op": "all_reduce", "path": "dataplane", "bytes": 8 << 20,
-               "comm": None, "crc": c, "reps": 3,
+               "comm": None, "crc_paired": True, "reps": 7,
                "wire_rate": 150_000_000,
-               "iters": iters_override or 2}
-              for c in ("1", "0")]
+               "iters": iters_override or 2}]
     # simulated host layout (host-contiguous): world >= 4 splits into two
     # "hosts" (the 2-host x 2-rank acceptance layout at world 4); smaller
     # worlds co-locate on one, so SHM lanes exist at every world
@@ -370,29 +406,31 @@ def main(argv=None) -> int:
         all_rows.extend(rows)
 
     # the ISSUE 2 / ISSUE 8 / ISSUE 9 acceptance quantities, when measured
-    # (crc rows excluded: they share every other key field with the plain
-    # 8 MiB row and would silently replace it)
+    # (guarded by metric: the crc_paired row shares op/world/bytes with
+    # the plain 8 MiB row and would silently replace it)
     by_key = {(r["op"], r["path"], r.get("comm", "f32"),
                r.get("algo", "flat"), r["world"], r["bytes"]): r["value"]
-              for r in all_rows if r.get("crc") is None}
+              for r in all_rows if r.get("metric") == "host_collective"}
     # ISSUE 13 gate: frame-checksum overhead at 8 MiB — armed (the
-    # production default) must cost < 5% effective MB/s vs disarmed
-    crc_vals = {(r["world"], r["crc"]): r["value"]
-                for r in all_rows if r.get("crc") is not None
+    # production default) must cost < 5% vs disarmed, as the median of
+    # back-to-back paired reps (load-robust: both arms of a pair see the
+    # same background contention)
+    crc_rows = {r["world"]: r for r in all_rows
+                if r.get("metric") == "crc_paired"
                 and r["bytes"] == 8 << 20}
     for world in worlds:
-        on = crc_vals.get((world, "1"))
-        off = crc_vals.get((world, "0"))
-        if on and off:
-            overhead = max(0.0, (off - on) / off * 100.0)
+        r = crc_rows.get(world)
+        if r:
             print(json.dumps({"metric": f"crc_overhead_8MiB_w{world}",
-                              "value": round(overhead, 2), "unit": "%",
-                              "threshold": 5.0}))
+                              "value": r["value"], "unit": "%",
+                              "threshold": 5.0, "pairs": r["pairs"],
+                              "estimator": "paired-median"}))
             if args.smoke:
-                assert overhead < 5.0, (
-                    f"CRC frame-checksum overhead {overhead:.1f}% at "
-                    f"8 MiB world {world} exceeds the 5% gate "
-                    f"(armed {on} vs unarmed {off} MB/s)")
+                assert r["value"] < 5.0, (
+                    f"CRC frame-checksum overhead {r['value']:.1f}% "
+                    f"(median of {r['pairs']} back-to-back pairs) at "
+                    f"8 MiB world {world} exceeds the 5% gate (armed "
+                    f"{r['on_mb_s']} vs unarmed {r['off_mb_s']} MB/s)")
     ring = by_key.get(("all_reduce", "dataplane", "f32", "flat", 4,
                        8 << 20))
     store_v = by_key.get(("all_reduce", "store", "f32", "flat", 4,

@@ -264,6 +264,21 @@ def publish(store, addr):
     store.set("tpu_dist/serve/gateway", addr)
 """
 
+# cluster control-plane keys (node registry, leases, replica liveness,
+# cross-launcher agreement) outlive generations and leader failovers BY
+# DESIGN; a near-miss namespace is still a violation
+TD003_CLUSTER_NEG = """
+def register(store, node, rnd):
+    store.set(f"tpu_dist/cluster/nodes/{node}", b"{}")
+    store.set(f"tpu_dist/cluster/lease/{node}", b"1")
+    store.set(f"tpu_dist/cluster/roles/fail/{rnd}", b"1")
+"""
+
+TD003_CLUSTER_POS = """
+def register(store, node):
+    store.set(f"tpu_dist/clusters/{node}", b"{}")
+"""
+
 # rank-divergent member list: every rank builds a DIFFERENT group, whose
 # ids/store scopes/wire tags can never match across ranks
 TD008_POS = """
@@ -595,6 +610,14 @@ class TestRules:
         # tpu_dist/serve/{backend,gateway} are cross-generation service
         # discovery BY DESIGN (the gateway re-resolves across restarts)
         assert _rules(lint_source(TD003_SERVE_NEG, "t.py")) == []
+
+    def test_td003_cluster_control_plane_allowlisted(self):
+        # tpu_dist/cluster/... (node registry, leases, cross-launcher
+        # agreement) outlives generations and leader failovers by design;
+        # the allowlist is path-segment-exact, so a near-miss namespace
+        # still fires
+        assert _rules(lint_source(TD003_CLUSTER_NEG, "t.py")) == []
+        assert _rules(lint_source(TD003_CLUSTER_POS, "t.py")) == ["TD003"]
 
     def test_syntax_error_is_td000(self):
         (f,) = lint_source("def broken(:\n", "bad.py")

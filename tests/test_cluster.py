@@ -1,0 +1,429 @@
+"""tpu_dist.cluster — the multi-node control plane.
+
+Tier-1 (`cluster` marker): endpoints-file units, follower replication
+(snapshot + mutation-log tail, deterministic lag via pause/resume,
+log-truncation re-snapshot), leader failover as clients see it (blocked
+waiters re-arming against the promoted follower, at-most-once ADD
+surfacing StoreFailoverError instead of double-applying), the
+deterministic lowest-live-node election run by real NodeAgents, the
+node-granularity netchaos store partition, and the cross-launcher
+membership / cluster-elastic planning units.  Everything here is
+in-process (threads as nodes); the spawned-launcher chaos e2es live in
+tests/test_cluster_e2e.py.
+"""
+
+import json
+import os
+import struct
+import threading
+import time
+
+import pytest
+
+from tpu_dist.cluster import (NodeAgent, StoreFollower, elastic_plan,
+                              leader_addr, live_nodes, publish_lease,
+                              read_endpoints, read_nodes, register_node,
+                              validate_placement, write_endpoints)
+from tpu_dist.cluster.endpoints import ENDPOINTS_ENV
+from tpu_dist.cluster.membership import (gather_elastic_counts, lease_key,
+                                         publish_elastic_counts,
+                                         read_leases, replica_key)
+from tpu_dist.dist.store import (PyTCPStoreServer, StoreFailoverError,
+                                 TCPStore)
+from tpu_dist.resilience import netchaos
+
+pytestmark = pytest.mark.cluster
+
+
+@pytest.fixture(autouse=True)
+def _no_netchaos():
+    yield
+    netchaos.uninstall()
+
+
+@pytest.fixture
+def leader(monkeypatch):
+    """A replicating leader server plus an endpoints file armed in the
+    environment — the exact client-side configuration every cluster
+    process runs with."""
+    monkeypatch.setenv("TPU_DIST_STORE_LOG_MAX", "10000")
+    srv = PyTCPStoreServer(0, replicate=True)
+    path = None
+    import tempfile
+    with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as f:
+        path = f.name
+    write_endpoints(path, f"127.0.0.1:{srv.port}", 0)
+    monkeypatch.setenv(ENDPOINTS_ENV, path)
+    yield srv, path
+    srv.stop()
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+
+
+def _client(port):
+    return TCPStore("127.0.0.1", port, timeout=20.0)
+
+
+# ---------------------------------------------------------------------------
+# endpoints file
+# ---------------------------------------------------------------------------
+
+
+class TestEndpoints:
+    def test_roundtrip_and_atomicity(self, tmp_path):
+        p = str(tmp_path / "ep.json")
+        assert read_endpoints(p) is None           # missing file
+        write_endpoints(p, "10.0.0.1:29501", 2,
+                        candidates={0: "10.0.0.1:29501",
+                                    1: "10.0.0.2:31044"})
+        doc = read_endpoints(p)
+        assert doc["leader"] == "10.0.0.1:29501"
+        assert doc["epoch"] == 2
+        assert doc["candidates"]["1"] == "10.0.0.2:31044"
+        assert leader_addr(p) == ("10.0.0.1", 29501)
+
+    def test_torn_or_invalid_reads_as_none(self, tmp_path):
+        p = str(tmp_path / "ep.json")
+        with open(p, "w") as f:
+            f.write('{"leader": "10.0.0.1:2')   # torn mid-write
+        assert read_endpoints(p) is None
+        with open(p, "w") as f:
+            json.dump({"epoch": 3}, f)          # no leader
+        assert read_endpoints(p) is None
+        assert leader_addr(p) is None
+
+
+# ---------------------------------------------------------------------------
+# follower replication
+# ---------------------------------------------------------------------------
+
+
+class TestReplication:
+    def test_snapshot_then_tail_converges(self, leader):
+        srv, _ = leader
+        c = _client(srv.port)
+        c.set("tpu_dist/cluster/pre", b"before-follower")
+        with StoreFollower("127.0.0.1", srv.port) as fo:
+            assert fo.wait_caught_up(srv.replication_seq())
+            assert fo.server.snapshot_items("")["tpu_dist/cluster/pre"] \
+                == b"before-follower"
+            c.set("tpu_dist/cluster/post", b"tailed")
+            c.add("tpu_dist/cluster/ctr", 5)    # replicated as SET-of-result
+            assert fo.wait_caught_up(srv.replication_seq())
+            kv = fo.server.snapshot_items("")
+            assert kv["tpu_dist/cluster/post"] == b"tailed"
+            assert kv["tpu_dist/cluster/ctr"] == struct.pack("<q", 5)
+        c.close()
+
+    def test_lagged_follower_replays_generation_reap_in_order(self, leader):
+        # THE replication-lag cell: the follower is deterministically
+        # paused across a generation reap (DELETE_PREFIX of g0 + the g1
+        # bootstrap writes); on resume it must replay the log in leader
+        # order and land on the reaped state — never resurrect g0 keys
+        srv, _ = leader
+        c = _client(srv.port)
+        for r in range(4):
+            c.set(f"tpu_dist/g0/coll/ar/0/{r}", b"x")
+        with StoreFollower("127.0.0.1", srv.port) as fo:
+            assert fo.wait_caught_up(srv.replication_seq())
+            fo.pause()
+            c.delete_prefix("tpu_dist/g0/")     # the generation reap
+            c.set("tpu_dist/generation", b"1")
+            c.set("tpu_dist/g1/coll/ar/0/0", b"y")
+            # paused: the follower still holds the pre-reap image
+            stale = fo.server.snapshot_items("tpu_dist/g0/")
+            assert len(stale) == 4
+            fo.resume()
+            assert fo.wait_caught_up(srv.replication_seq())
+            assert fo.server.snapshot_items("tpu_dist/g0/") == {}
+            kv = fo.server.snapshot_items("")
+            assert kv["tpu_dist/generation"] == b"1"
+            assert kv["tpu_dist/g1/coll/ar/0/0"] == b"y"
+        c.close()
+
+    def test_truncated_log_triggers_resnapshot(self, leader, monkeypatch):
+        # a follower paused past the leader's log retention must converge
+        # through a fresh snapshot, not fail or silently diverge
+        srv, _ = leader
+        monkeypatch.setenv("TPU_DIST_STORE_LOG_MAX", "8")
+        # this cell runs its own tiny-log leader: point the client at it
+        # directly, not through the fixture's endpoints file
+        monkeypatch.delenv(ENDPOINTS_ENV)
+        monkeypatch.setenv("TPU_DIST_STORE_REPLICATE", "1")
+        small = PyTCPStoreServer(0, replicate=True)
+        try:
+            c = _client(small.port)
+            c.set("seed", b"0")
+            with StoreFollower("127.0.0.1", small.port) as fo:
+                assert fo.wait_caught_up(small.replication_seq())
+                fo.pause()
+                for i in range(32):             # 4x the log bound
+                    c.set(f"k/{i}", str(i).encode())
+                fo.resume()
+                assert fo.wait_caught_up(small.replication_seq())
+                kv = fo.server.snapshot_items("k/")
+                assert len(kv) == 32 and kv["k/31"] == b"31"
+            c.close()
+        finally:
+            small.stop()
+
+
+# ---------------------------------------------------------------------------
+# failover as clients experience it
+# ---------------------------------------------------------------------------
+
+
+class TestClientFailover:
+    def test_blocked_waiter_rearms_on_promoted_follower(self, leader):
+        # a GET blocked on the dying leader re-resolves the endpoints
+        # file and re-arms against the promoted follower — the waiter's
+        # caller never sees the leadership change
+        srv, path = leader
+        fo = StoreFollower("127.0.0.1", srv.port).start()
+        try:
+            c = _client(srv.port)
+            got = {}
+            t = threading.Thread(
+                target=lambda: got.update(v=c.get("late/key")), daemon=True)
+            t.start()
+            time.sleep(0.3)                     # GET is blocked server-side
+            host, port = fo.promote()
+            write_endpoints(path, f"{host}:{port}", 1)
+            srv.stop()                          # wakes the waiter: status 1
+            admin = _client(port)               # follows endpoints -> new
+            admin.set("late/key", b"after-failover")
+            t.join(timeout=15)
+            assert got.get("v") == b"after-failover"
+            c.close()
+            admin.close()
+        finally:
+            fo.stop()
+
+    def test_at_most_once_add_across_leader_kill(self, leader):
+        # an ADD in flight across the failover must NOT be replayed (the
+        # dead leader may have applied it): it surfaces as a
+        # StoreFailoverError naming both leaders and the new epoch, and
+        # the counter on the promoted follower holds exactly the applied
+        # history
+        srv, path = leader
+        fo = StoreFollower("127.0.0.1", srv.port).start()
+        try:
+            c = _client(srv.port)
+            assert c.add("tpu_dist/cluster/ctr", 1) == 1
+            assert fo.wait_caught_up(srv.replication_seq())
+            host, port = fo.promote()
+            write_endpoints(path, f"{host}:{port}", 1)
+            srv.stop()
+            # the kill: an in-process stop() leaves established
+            # connections on zombie handler threads, so sever the wire
+            # the way a real SIGKILL would (the netchaos conn-reset cell)
+            netchaos.install("conn-reset:surface=store,frame=1")
+            with pytest.raises(StoreFailoverError) as ei:
+                c.add("tpu_dist/cluster/ctr", 1)
+            netchaos.uninstall()
+            assert ei.value.epoch == 1
+            assert ei.value.new_leader.endswith(str(port))
+            assert ei.value.old_leader != ei.value.new_leader
+            # read-first re-issue (what the error message prescribes):
+            # the replicated counter is exactly 1 — not double-applied
+            assert c.get("tpu_dist/cluster/ctr") == struct.pack("<q", 1)
+            assert c.add("tpu_dist/cluster/ctr", 1) == 2
+            c.close()
+        finally:
+            fo.stop()
+
+
+# ---------------------------------------------------------------------------
+# the election (real NodeAgents, in-process)
+# ---------------------------------------------------------------------------
+
+
+class TestElection:
+    def test_lowest_live_node_promotes_and_peers_follow(self, leader):
+        srv, path = leader
+        fo1 = StoreFollower("127.0.0.1", srv.port, down_after=0.6).start()
+        fo2 = StoreFollower("127.0.0.1", srv.port, down_after=0.6).start()
+        a1 = NodeAgent(1, path, follower=fo1, nproc=2,
+                       lease_interval=0.1, lease_ttl=0.8).start()
+        a2 = NodeAgent(2, path, follower=fo2, nproc=2,
+                       lease_interval=0.1, lease_ttl=0.8).start()
+        try:
+            c = _client(srv.port)
+            c.wait([replica_key(1), replica_key(2)], timeout=10)
+            c.set("survives", b"the-failover")
+            # every candidate must hold the candidate table + leases
+            # BEFORE the kill — the election runs from replica state alone
+            seq = srv.replication_seq()
+            assert fo1.wait_caught_up(seq) and fo2.wait_caught_up(seq)
+            c.close()
+            srv.stop()                          # leader dies
+            assert a1.is_leader.wait(timeout=15), "node 1 never promoted"
+            doc = read_endpoints(path)
+            assert doc["epoch"] == 1
+            assert doc["leader"].endswith(str(fo1.port))
+            # node 2 followed the epoch change instead of split-braining
+            time.sleep(0.5)
+            assert not a2.is_leader.is_set()
+            c2 = _client(fo1.port)
+            assert c2.get("survives") == b"the-failover"
+            c2.close()
+        finally:
+            a1.stop()
+            a2.stop()
+            fo1.stop()
+            fo2.stop()
+
+    def test_election_skips_stale_leased_candidate(self, leader):
+        # node 1 is a candidate but its lease went stale (it is as dead
+        # as the leader): the election must pick the lowest LIVE node
+        srv, path = leader
+        fo2 = StoreFollower("127.0.0.1", srv.port, down_after=0.6).start()
+        try:
+            c = _client(srv.port)
+            # a phantom node-1 candidate whose lease is far in the past
+            c.set(replica_key(1), b"127.0.0.1:1")
+            c.set(lease_key(1),
+                  json.dumps({"node": 1, "t": time.time() - 3600}).encode())
+            a2 = NodeAgent(2, path, follower=fo2, nproc=2,
+                           lease_interval=0.1, lease_ttl=0.8).start()
+            c.wait([replica_key(2)], timeout=10)
+            seq = srv.replication_seq()
+            assert fo2.wait_caught_up(seq)
+            c.close()
+            srv.stop()
+            assert a2.is_leader.wait(timeout=15), "node 2 never promoted"
+            assert read_endpoints(path)["leader"].endswith(str(fo2.port))
+            a2.stop()
+        finally:
+            fo2.stop()
+
+
+# ---------------------------------------------------------------------------
+# node-granularity netchaos store partition
+# ---------------------------------------------------------------------------
+
+
+class TestNodePartition:
+    def test_partition_cell_scoped_to_one_node(self, leader, monkeypatch):
+        # `partition:surface=store,node=1` is the top-of-rack-death cell:
+        # every process on node 1 loses the store wire, every other node
+        # (and a process with no node identity at all) is untouched
+        srv, _ = leader
+        spec = "partition:surface=store,node=1"
+        c = _client(srv.port)
+        c.set("cell", b"up")
+
+        monkeypatch.setenv("NODE_RANK", "1")
+        netchaos.install(spec)
+        with pytest.raises(ConnectionError, match="injected store "
+                                                  "partition"):
+            c.get("cell")
+        netchaos.uninstall()
+
+        monkeypatch.setenv("NODE_RANK", "0")    # a different node
+        netchaos.install(spec)
+        assert c.get("cell") == b"up"
+        netchaos.uninstall()
+
+        monkeypatch.delenv("NODE_RANK", raising=False)
+        monkeypatch.delenv("TPU_DIST_NODE_ID", raising=False)
+        netchaos.install(spec)                  # no node identity at all
+        assert c.get("cell") == b"up"           # stays disarmed
+        c.close()
+
+
+# ---------------------------------------------------------------------------
+# membership + cluster-wide elastic planning
+# ---------------------------------------------------------------------------
+
+
+class TestMembership:
+    def test_register_lease_live(self, leader, monkeypatch):
+        srv, _ = leader
+        c = _client(srv.port)
+        monkeypatch.setenv("TPU_DIST_NODE_CLASS", "tpu-v4")
+        rec = register_node(c, 0, nproc=4)
+        register_node(c, 1, nproc=4, node_class="cpu")
+        nodes = read_nodes(c, nnodes=3)         # node 2 never registered
+        assert set(nodes) == {0, 1}
+        assert nodes[0]["class"] == "tpu-v4" and nodes[1]["class"] == "cpu"
+        assert nodes[0]["host"] == rec["host"]
+        publish_lease(c, 0)
+        publish_lease(c, 1)
+        leases = read_leases(
+            {k: c.get(k) for k in (lease_key(0), lease_key(1))})
+        assert set(leases) == {0, 1}
+        c.close()
+
+    def test_live_nodes_is_relative_freshness(self):
+        # freshness is judged against the NEWEST lease, so clocks only
+        # need to tick, not agree
+        now = 1_000_000.0
+        leases = {0: now, 1: now - 0.5, 2: now - 30.0}
+        assert live_nodes(leases, ttl=5.0) == {0, 1}
+        assert live_nodes({}, ttl=5.0) == set()
+
+    def test_elastic_counts_roundtrip(self, leader):
+        srv, _ = leader
+        c = _client(srv.port)
+        publish_elastic_counts(c, 3, 0, nproc=4, full_nproc=4,
+                               preempted=0, grow=False)
+        publish_elastic_counts(c, 3, 1, nproc=4, full_nproc=4,
+                               preempted=2, grow=False)
+        counts = gather_elastic_counts(c, 3, nnodes=2, timeout=5)
+        assert counts[1]["preempted"] == 2 and counts[0]["nproc"] == 4
+        c.close()
+
+
+class TestElasticPlan:
+    RECORDS = {0: {"host": "hostA"}, 1: {"host": "hostB"}}
+
+    def test_shrink_drops_the_preempted_nodes_ranks(self):
+        counts = {0: {"nproc": 4, "full_nproc": 4, "preempted": 0},
+                  1: {"nproc": 4, "full_nproc": 4, "preempted": 2}}
+        plan = elastic_plan(counts, self.RECORDS, lo=2, hi=8)
+        assert plan == {0: (0, 4), 1: (4, 2)}
+
+    def test_a_node_may_drop_to_zero_and_idle(self):
+        counts = {0: {"nproc": 4, "full_nproc": 4, "preempted": 0},
+                  1: {"nproc": 4, "full_nproc": 4, "preempted": 4}}
+        plan = elastic_plan(counts, self.RECORDS, lo=2, hi=8)
+        assert plan == {0: (0, 4), 1: (4, 0)}
+
+    def test_grow_returns_to_capacity_clamped(self):
+        counts = {0: {"nproc": 4, "full_nproc": 4, "preempted": 0,
+                      "grow": True},
+                  1: {"nproc": 0, "full_nproc": 4, "preempted": 0}}
+        assert elastic_plan(counts, self.RECORDS, lo=2, hi=8) \
+            == {0: (0, 4), 1: (4, 4)}
+        assert elastic_plan(counts, self.RECORDS, lo=2, hi=6) \
+            == {0: (0, 4), 1: (4, 2)}
+
+    def test_none_when_below_floor_or_unchanged(self):
+        counts = {0: {"nproc": 4, "full_nproc": 4, "preempted": 3},
+                  1: {"nproc": 4, "full_nproc": 4, "preempted": 4}}
+        assert elastic_plan(counts, self.RECORDS, lo=2, hi=8) is None
+        counts = {0: {"nproc": 4, "full_nproc": 4, "preempted": 0},
+                  1: {"nproc": 4, "full_nproc": 4, "preempted": 0}}
+        assert elastic_plan(counts, self.RECORDS, lo=2, hi=8) is None
+
+    def test_host_fingerprint_order_decides_base_ranks(self):
+        # WHICH node's ranks drop (and who starts at rank 0) is the
+        # topology layer's host order, never a per-launcher opinion
+        records = {0: {"host": "zzz"}, 1: {"host": "aaa"}}
+        counts = {0: {"nproc": 4, "full_nproc": 4, "preempted": 1},
+                  1: {"nproc": 4, "full_nproc": 4, "preempted": 0}}
+        plan = elastic_plan(counts, records, lo=2, hi=8)
+        assert plan == {1: (0, 4), 0: (4, 3)}
+        # unregistered nodes sort after registered ones, tied by id
+        plan2 = elastic_plan(counts, {}, lo=2, hi=8)
+        assert plan2 == {0: (0, 3), 1: (3, 4)}
+
+    def test_placement_pins_validated_against_cluster_size(self):
+        from tpu_dist.roles import RoleGraph, Role
+        g = RoleGraph([Role("learner", 1), Role("actor", 3, node=1)])
+        validate_placement(g, nnodes=2)         # fits
+        with pytest.raises(ValueError, match="actor"):
+            validate_placement(g, nnodes=1)

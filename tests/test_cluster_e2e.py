@@ -1,0 +1,271 @@
+"""Cluster chaos end-to-end: the ISSUE 16 acceptance runs.
+
+Two multi-node control-plane proofs, real OS processes on the CPU backend:
+
+1. **Store-leader SIGKILL mid-training** — external ``tpu_dist.cluster
+   .agent`` processes host the replicated store (node 0 leads, node 1
+   follows); a launcher in ``--store_endpoints`` client mode trains through
+   a SIGKILL of the leader agent.  The follower wins the election, promotes
+   its replica, rewrites the endpoints file at epoch 1, and every client
+   re-resolves — training finishes in generation 0 with the restart budget
+   untouched.
+
+2. **Two-launcher 8→4→8 elastic run crossing a node boundary** — two
+   launchers (4 ranks each) share one replicated store; chaos preempts all
+   of node 1's ranks at step 5 (the shrink is a CLUSTER decision: node 1
+   drops to zero ranks and idles), then grows back to 8 at step 8.  Each
+   destination-world phase must be BITWISE equal to an uninterrupted
+   single-launcher run at that world size resumed from the same checkpoint
+   tree.
+
+Both runs spawn 8-10 jax processes across multiple generations, so they are
+``slow``-marked (nightly tier) to protect the tier-1 wall-clock budget; the
+control-plane units they integrate (election, replication lag, at-most-once
+failover, waiter re-arm) run tier-1 in test_cluster.py.
+"""
+
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from test_chaos_e2e import (_REPO, _ZERO_TRAIN_WORKER, _finals, _gen_losses,
+                            _launch_train, _trim_ckpt_tree)
+
+pytestmark = [pytest.mark.cluster, pytest.mark.chaos,
+              pytest.mark.multiprocess, pytest.mark.slow]
+
+
+def _agent_env(extra=None):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # fast failover: leases every 0.2s, leader condemned after 1s of dead
+    # probes, follower tails at 20ms — the election lands well inside the
+    # client reconnect window (~12s of backed-off attempts)
+    env.update({"TPU_DIST_CLUSTER_LEASE_INTERVAL": "0.2",
+                "TPU_DIST_CLUSTER_LEASE_TTL": "1.0",
+                "TPU_DIST_STORE_REPL_POLL": "0.02",
+                "TPU_DIST_STORE_DOWN_AFTER": "1.0"})
+    env.update(extra or {})
+    return env
+
+
+def _spawn_agent(node_id, ep, ready, *, lead=False, extra_env=None):
+    cmd = [sys.executable, "-m", "tpu_dist.cluster.agent",
+           "--node_id", str(node_id), "--endpoints", str(ep),
+           "--ready_file", str(ready)]
+    if lead:
+        cmd.append("--lead")
+    proc = subprocess.Popen(cmd, cwd=_REPO, env=_agent_env(extra_env),
+                            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                            text=True)
+    deadline = time.monotonic() + 30
+    while not os.path.exists(ready):
+        assert proc.poll() is None, f"agent {node_id} died before ready"
+        assert time.monotonic() < deadline, f"agent {node_id} never ready"
+        time.sleep(0.05)
+    with open(ready) as f:
+        return proc, json.load(f)
+
+
+def _wait_step(path, step, deadline, procs):
+    """Block until losses file ``path`` records ``step`` (training reached
+    mid-run) — the kill must land while steps are still being taken."""
+    while time.monotonic() < deadline:
+        for p in procs:
+            assert p.poll() is None, "process died before the kill point"
+        try:
+            with open(path) as f:
+                if str(step) in json.load(f):
+                    return
+        except (OSError, ValueError):
+            pass
+        time.sleep(0.1)
+    raise AssertionError(f"step {step} never appeared in {path}")
+
+
+def test_store_leader_sigkill_training_rides_failover(tmp_path):
+    """ISSUE 16 acceptance: SIGKILL the store-leader agent mid-training.
+    The follower node's agent detects the dead leader, wins the
+    deterministic election, promotes its replica (endpoints epoch 0 -> 1),
+    and the in-flight training run — whose gradients ride the p2p data
+    plane while every store client re-resolves the new leader — finishes
+    in generation 0 without burning a restart."""
+    ep = tmp_path / "ep.json"
+    leader, lead_info = _spawn_agent(0, ep, tmp_path / "r0.json", lead=True)
+    follower, foll_info = _spawn_agent(1, ep, tmp_path / "r1.json")
+    train = None
+    try:
+        out_dir = tmp_path / "train"
+        out_dir.mkdir()
+        script = tmp_path / "worker.py"
+        script.write_text(_ZERO_TRAIN_WORKER)
+        env = _agent_env({
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            # EVERY gradient leaf on the p2p data plane: the store must be
+            # free of in-flight at-most-once ops during the election window
+            # (idempotent ops retry across it; a failed SET/ADD cannot)
+            "TPU_DIST_DP_THRESHOLD": "0",
+            # no checkpoint barrier lands mid-run either
+            "E2E_SAVE_EVERY": "50"})
+        env.pop("TPU_DIST_CHAOS", None)
+        train = subprocess.Popen(
+            [sys.executable, "-m", "tpu_dist.launch", "--nproc_per_node=2",
+             "--master_port=0", "--max_restarts=1", "--restart_backoff=0.1",
+             "--heartbeat_timeout=10", f"--store_endpoints={ep}",
+             str(script), str(out_dir), str(out_dir / "ckpt"), "12"],
+            cwd=_REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True)
+
+        _wait_step(out_dir / "losses_g0_r0.json", 3,
+                   time.monotonic() + 180, [train, leader, follower])
+        leader.send_signal(signal.SIGKILL)
+        out, err = train.communicate(timeout=300)
+        assert train.returncode == 0, f"stdout:\n{out}\nstderr:\n{err}"
+        # the failover rode OUTSIDE the restart budget
+        assert "relaunching" not in err, err
+
+        fa = _finals(out_dir, nproc=2)
+        for rank in (0, 1):
+            assert fa[rank]["generation"] == 0, fa[rank]
+            assert fa[rank]["start"] == 0, fa[rank]
+            assert set(fa[rank]["losses"]) == {str(s) for s in range(12)}
+        assert len({f["params_sha256"] for f in fa.values()}) == 1
+
+        # the promoted follower is now the published leader
+        with open(ep) as f:
+            published = json.load(f)
+        assert published["epoch"] == 1, published
+        assert published["leader"] == f"127.0.0.1:{foll_info['port']}", \
+            (published, foll_info)
+        follower.send_signal(signal.SIGTERM)
+        agent_out = follower.communicate(timeout=20)[0]
+        assert "store-failover-promoted" in agent_out, agent_out
+    finally:
+        for p in (train, leader, follower):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.communicate(timeout=20)
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _launch_node(node_rank, store_port, ep, script, out_dir, ckpt, n_steps,
+                 log_path, chaos):
+    env = _agent_env({
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        "TPU_DIST_DP_THRESHOLD": "1024",
+        "TPU_DIST_CHAOS": chaos,
+        "TPU_DIST_PREEMPT_SETTLE": "3",
+        "E2E_SAVE_EVERY": "2"})
+    log = open(log_path, "w")
+    return subprocess.Popen(
+        [sys.executable, "-m", "tpu_dist.launch", "--nnodes=2",
+         f"--node_rank={node_rank}", "--nproc_per_node=4",
+         "--master_port=0", f"--store_port={store_port}",
+         f"--store_endpoints={ep}", "--store_replica",
+         "--elastic_world=4:8", "--restart_backoff=0.1",
+         "--elastic_timeout=60",
+         str(script), str(out_dir), str(ckpt), str(n_steps)],
+        cwd=_REPO, env=env, stdout=log, stderr=subprocess.STDOUT, text=True)
+
+
+@pytest.mark.zero
+@pytest.mark.elastic
+def test_two_launcher_elastic_8_4_8_across_node_boundary(tmp_path):
+    """ISSUE 16 acceptance: a two-launcher world-8 ZeRO run (4 ranks per
+    node) is preempted down to world 4 — ALL of node 1's ranks exit
+    PREEMPTED at step 5, so the re-form crosses a node boundary: node 1
+    idles at zero ranks while node 0 reshards the world-8 step-4 tree and
+    carries the world-4 phase alone.  At step 8 capacity returns and the
+    cluster grows back to 8, resharding the world-4 step-8 tree across
+    both nodes again.  Both transitions are cluster decisions outside the
+    restart budget, and each destination-world phase is bitwise equal to
+    an uninterrupted single-launcher run at that world size resumed from
+    the same checkpoint tree."""
+    script = tmp_path / "worker.py"
+    script.write_text(_ZERO_TRAIN_WORKER)
+    out_dir = tmp_path / "elastic"
+    out_dir.mkdir()
+    ckpt = out_dir / "ckpt"
+    ep = tmp_path / "ep.json"
+    store_port = _free_port()
+    chaos = (";".join(f"shrink:rank={r},step=5" for r in range(4, 8))
+             + ";grow:rank=0,step=8,world=8")
+    logs = [tmp_path / f"launch{n}.log" for n in (0, 1)]
+    procs = [_launch_node(n, store_port, ep, script, out_dir, ckpt, 12,
+                          logs[n], chaos) for n in (0, 1)]
+    try:
+        deadline = time.monotonic() + 900
+        for p in procs:
+            p.wait(timeout=max(1, deadline - time.monotonic()))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=20)
+    texts = [log.read_text() for log in logs]
+    assert procs[0].returncode == 0, f"node0:\n{texts[0]}\nnode1:\n{texts[1]}"
+    assert procs[1].returncode == 0, f"node0:\n{texts[0]}\nnode1:\n{texts[1]}"
+    both = texts[0] + texts[1]
+    # both world changes were cluster re-forms outside the restart budget
+    assert "cluster elastic re-form: world 8 -> 4" in both, both
+    assert "cluster elastic re-form: world 4 -> 8" in both, both
+    assert "restart budget untouched" in both, both
+    assert "relaunching" not in both, both
+    # the shrink crossed the node boundary: node 1 idled at zero ranks
+    assert "node 1 runs 0 rank(s)" in texts[1], texts[1]
+    assert "node 0 runs 4 rank(s) from base 0" in texts[0], texts[0]
+
+    fa = _finals(out_dir, nproc=8)
+    for rank in range(8):
+        assert fa[rank]["generation"] == 2, fa[rank]
+        assert fa[rank]["start"] == 9, fa[rank]   # resharded from step 8
+
+    # --- world-4 phase vs an uninterrupted single-launcher world-4 run
+    # resumed from the same world-8 step-4 tree
+    ckpt_b = tmp_path / "ckpt_fixed4"
+    shutil.copytree(ckpt, ckpt_b)
+    _trim_ckpt_tree(str(ckpt_b), 4)
+    rb, dir_b = _launch_train(
+        tmp_path, "fixed4", n_steps=12, worker_src=_ZERO_TRAIN_WORKER,
+        nproc=4, ckpt_root=ckpt_b, extra_env={"E2E_SAVE_EVERY": "2"},
+        timeout=600)
+    assert rb.returncode == 0, f"stdout:\n{rb.stdout}\nstderr:\n{rb.stderr}"
+    fb = _finals(dir_b, nproc=4)
+    for rank in range(4):
+        assert fb[rank]["start"] == 5, fb[rank]   # resharded 8->4 resume
+        la, lb = _gen_losses(out_dir, 1, rank), _gen_losses(dir_b, 0, rank)
+        for step in range(5, 9):
+            assert la[str(step)] == lb[str(step)], \
+                f"world-4 phase diverged at step {step} rank {rank}"
+
+    # --- world-8 phase vs an uninterrupted single-launcher world-8 run
+    # resumed from the same world-4 step-8 tree, params included
+    ckpt_c = tmp_path / "ckpt_fixed8"
+    shutil.copytree(ckpt, ckpt_c)
+    _trim_ckpt_tree(str(ckpt_c), 8)
+    rc, dir_c = _launch_train(
+        tmp_path, "fixed8", n_steps=12, worker_src=_ZERO_TRAIN_WORKER,
+        nproc=8, ckpt_root=ckpt_c, extra_env={"E2E_SAVE_EVERY": "2"},
+        timeout=600)
+    assert rc.returncode == 0, f"stdout:\n{rc.stdout}\nstderr:\n{rc.stderr}"
+    fc = _finals(dir_c, nproc=8)
+    for rank in range(8):
+        assert fc[rank]["start"] == 9, fc[rank]   # resharded 4->8 resume
+        for step in range(9, 12):
+            assert fa[rank]["losses"][str(step)] == \
+                fc[rank]["losses"][str(step)], \
+                f"world-8 phase diverged at step {step} rank {rank}"
+    digests = {f["params_sha256"] for f in (*fa.values(), *fc.values())}
+    assert len(digests) == 1, f"parameter divergence: {digests}"

@@ -288,6 +288,30 @@ class TestTrace:
     def test_diagnose_empty(self):
         assert obs.diagnose([])["verdict"] == "no-dumps"
 
+    @pytest.mark.cluster
+    def test_diagnose_surfaces_store_failover_naming_promoted_leader(
+            self, tmp_path):
+        # a client that rode a leader failover records kind="store"
+        # op="failover" (store.py's endpoint re-resolution); the merged
+        # diagnosis must surface the control-plane move and NAME the
+        # promoted leader, whatever the hang/straggler verdict is
+        rec = obs.FlightRecorder(capacity=64, rank=0, world=2)
+        ev = rec.begin("collective", "all_reduce", coll=0,
+                       site="train.py:10", reduce="sum")
+        rec.end(ev)
+        rec.record("store", "failover", key="127.0.0.1:9102",
+                   old="127.0.0.1:9101", epoch=1)
+        rec.dump("test", dir=str(tmp_path))
+        _mk_dump(tmp_path, 1, 1, pending=False)
+        d = obs.diagnose(obs.read_dumps(str(tmp_path)))
+        assert d["store_failovers"] == [
+            {"rank": 0, "leader": "127.0.0.1:9102",
+             "old": "127.0.0.1:9101", "epoch": 1}]
+        text = obs.render_diagnosis(d)
+        assert "leader 127.0.0.1:9101 lost" in text, text
+        assert "promoted leader 127.0.0.1:9102" in text, text
+        assert "epoch 1" in text and "rank(s) [0]" in text, text
+
     def test_diagnose_missing_ranks_is_not_healthy(self, tmp_path):
         # a SIGKILLed rank leaves no dump: a clean-looking partial world
         # must not read as healthy

@@ -403,6 +403,43 @@ class TestChannel:
         assert cons2.get(timeout=5) == "survives"      # not skipped
         assert cons2.qsize() == 0                      # window intact
 
+    def test_multiconsumer_killed_claims_inherited_by_respawn(
+            self, store, monkeypatch):
+        # the MPMC twin of the rewind above: multi-consumer claims cannot
+        # be returned (a sibling may have claimed past), so each endpoint
+        # persists its outstanding claims (claims/{rank}); an incarnation
+        # killed while HOLDING one respawns into an endpoint that inherits
+        # the claim into its abandoned ledger — a late write is delivered
+        # and a true hole is settle-acked, never a leaked window
+        monkeypatch.setenv("TPU_DIST_CH_HOLE_SETTLE", "0.2")
+        prod, cons = _pair(store, name="mck", src=(1,), dst=(0, 2))
+        base = "tpu_dist/g0/roles/ch/mck"
+        store.add(f"{base}/head", 1)        # slot 0 claimed, never written
+        with pytest.raises(ChannelTimeoutError):
+            cons.get(timeout=0.3)           # claims slot 0...
+        assert json.loads(store.get(f"{base}/claims/0").decode()) == [0]
+        del cons                            # ...then SIGKILL: no unwind
+        cons2 = Channel(prod.spec, store, rank=0, role="cons",
+                        src_span=[1], dst_span=[0, 2], generation=0,
+                        graph_world=3, dp=False)  # the respawn
+        assert 0 in cons2._abandoned        # reconciled from the ledger
+        store.set(f"{base}/m/0", prod._encode("late", 0))
+        assert cons2.get(timeout=5) == "late"  # late write delivered
+        assert cons2.qsize() == 0
+        store.add(f"{base}/head", 1)        # slot 1: claimed, never written
+        with pytest.raises(ChannelTimeoutError):
+            cons2.get(timeout=0.3)          # claims slot 1, killed again
+        cons3 = Channel(prod.spec, store, rank=0, role="cons",
+                        src_span=[1], dst_span=[0, 2], generation=0,
+                        graph_world=3, dp=False)  # second respawn
+        assert 1 in cons3._abandoned
+        prod.put("live", timeout=5)         # slot 2
+        assert cons3.get(timeout=5) == "live"  # sweep arms hole-1 clock
+        time.sleep(0.35)                    # starve past the settle
+        with pytest.raises(ChannelTimeoutError):
+            cons3.get(timeout=0.3)          # sweep acks the settled hole
+        assert cons3.qsize() == 0           # window intact after two kills
+
     def test_crash_unwind_posts_no_eof_marker(self, store):
         # `with ch:` unwinding on an exception must NOT post the clean-EOF
         # marker — the supervisor may be about to solo-respawn this rank,

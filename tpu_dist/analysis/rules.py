@@ -126,6 +126,13 @@ TD003_ALLOWED_PREFIXES = (
                             # addresses): overwritten by each incarnation and
                             # read ACROSS restarts by design — the gateway
                             # re-resolves a restarted backend through it
+    "tpu_dist/cluster",     # cluster control plane (node registry, leases,
+                            # replica liveness, cross-launcher elastic
+                            # counts and roles-gang agreement): written by
+                            # node agents/launchers, read ACROSS
+                            # generations and leader failovers by design —
+                            # the election and the cluster re-form both
+                            # outlive any single generation
     "tpu_dist/g",           # already in the generation namespace
 )
 

@@ -1,0 +1,32 @@
+"""tpu_dist.cluster — the multi-node control plane.
+
+Removes the single-point-of-failure TCPStore and lifts the single-node
+pins on ``--elastic_world`` and ``--roles``:
+
+- :mod:`~tpu_dist.cluster.endpoints` — the atomic endpoints file every
+  client re-resolves the leader from (``TPU_DIST_STORE_ENDPOINTS``).
+- :mod:`~tpu_dist.cluster.replica` — :class:`StoreFollower`, a live
+  replica tailing the leader's mutation log with snapshot catch-up.
+- :mod:`~tpu_dist.cluster.agent` — :class:`NodeAgent`, the per-node
+  sidecar (leases, membership, leader watchdog, deterministic election);
+  also a standalone process via ``python -m tpu_dist.cluster.agent``.
+- :mod:`~tpu_dist.cluster.membership` — node records, the cluster-wide
+  elastic plan (which node's ranks drop, in host-fingerprint order), and
+  role-placement validation.
+
+See docs/resilience.md ("Cluster control plane") for the election
+protocol, knobs and failure taxonomy.
+"""
+
+from ..dist.store import StoreFailoverError
+from .agent import NodeAgent
+from .endpoints import (ENDPOINTS_ENV, leader_addr, read_endpoints,
+                        write_endpoints)
+from .membership import (elastic_plan, live_nodes, publish_lease,
+                         read_nodes, register_node, validate_placement)
+from .replica import StoreFollower
+
+__all__ = ["StoreFailoverError", "NodeAgent", "StoreFollower",
+           "ENDPOINTS_ENV", "write_endpoints", "read_endpoints",
+           "leader_addr", "register_node", "read_nodes", "publish_lease",
+           "live_nodes", "elastic_plan", "validate_placement"]

@@ -1,0 +1,194 @@
+"""Follower replica of the control-plane store.
+
+A :class:`StoreFollower` owns a local replicating
+:class:`~tpu_dist.dist.store.PyTCPStoreServer` and keeps it converged with
+the leader by tailing the leader's mutation log:
+
+1. one ``_OP_SNAPSHOT`` on start (atomic kv image + sequence number),
+2. then ``_OP_LOG_SINCE`` polls every ``TPU_DIST_STORE_REPL_POLL`` seconds
+   (default 0.05), applying SET/DELETE/DELETE_PREFIX entries in leader
+   order.  ADD never appears in the log — the leader logs it as a SET of
+   the resulting value, so replay is idempotent.
+3. A truncated log (the follower fell further behind than the leader's
+   retention) is answered with a re-snapshot flag and the follower starts
+   over from a fresh image — bounded memory on the leader, guaranteed
+   convergence on the follower.
+
+The follower's server is live (and connectable) the whole time; promotion
+is therefore nothing but *stopping the tail* and pointing the endpoints
+file at it — blocked GET/WAIT_GE waiters that reconnect land on a server
+whose condition variable wakes them exactly like the original leader's.
+
+Leader-death detection here is deliberately coarse (consecutive tail
+failures spanning ``down_after`` seconds set :attr:`leader_lost`); the
+node agent (tpu_dist/cluster/agent.py) combines it with lease freshness to
+run the deterministic election.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import time
+from typing import Optional, Tuple
+
+from ..dist.store import (PyTCPStoreServer, _OP_LOG_SINCE, _OP_SNAPSHOT,
+                          _PyClient)
+
+__all__ = ["StoreFollower", "parse_snapshot", "parse_log"]
+
+
+def parse_snapshot(body: bytes) -> Tuple[int, dict]:
+    """Decode an ``_OP_SNAPSHOT`` reply → ``(seq, {key: value})``."""
+    seq, count = struct.unpack_from("<qI", body)
+    off, items = 12, {}
+    for _ in range(count):
+        (klen,) = struct.unpack_from("<I", body, off)
+        off += 4
+        key = body[off:off + klen].decode()
+        off += klen
+        (vlen,) = struct.unpack_from("<I", body, off)
+        off += 4
+        items[key] = body[off:off + vlen]
+        off += vlen
+    return seq, items
+
+
+def parse_log(body: bytes):
+    """Decode an ``_OP_LOG_SINCE`` reply.
+
+    Returns ``None`` when the leader signalled re-snapshot (flag 1), else
+    ``(leader_seq, [(seq, op, key, payload), ...])``."""
+    if body[0] == 1:
+        return None
+    leader_seq, count = struct.unpack_from("<qI", body, 1)
+    off, entries = 13, []
+    for _ in range(count):
+        seq, op, klen = struct.unpack_from("<qBI", body, off)
+        off += 13
+        key = body[off:off + klen].decode()
+        off += klen
+        (plen,) = struct.unpack_from("<I", body, off)
+        off += 4
+        entries.append((seq, op, key, body[off:off + plen]))
+        off += plen
+    return leader_seq, entries
+
+
+class StoreFollower:
+    """Tails a leader store into a live local replica server.
+
+    ``pause()``/``resume()`` freeze the tail (the replication-lag tests
+    use this to put the follower deterministically behind a generation
+    reap); ``promote()`` stops the tail for good and returns the replica
+    server's address.  :attr:`leader_lost` is set once tail polls have
+    failed continuously for ``down_after`` seconds.
+    """
+
+    def __init__(self, leader_host: str, leader_port: int, port: int = 0,
+                 poll: Optional[float] = None,
+                 down_after: Optional[float] = None):
+        self.leader_host, self.leader_port = leader_host, leader_port
+        self.server = PyTCPStoreServer(port, replicate=True)
+        self.port = self.server.port
+        self._poll = (poll if poll is not None else float(
+            os.environ.get("TPU_DIST_STORE_REPL_POLL", "0.05")))
+        self.down_after = (down_after if down_after is not None else float(
+            os.environ.get("TPU_DIST_STORE_DOWN_AFTER", "2.0")))
+        self._client: Optional[_PyClient] = None
+        self._stop = threading.Event()
+        self._paused = threading.Event()
+        self._promoted = threading.Event()
+        self.leader_lost = threading.Event()
+        self._first_fail: Optional[float] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def seq(self) -> int:
+        return self.server.replication_seq()
+
+    def start(self) -> "StoreFollower":
+        self._client = _PyClient(self.leader_host, self.leader_port,
+                                 timeout=10.0, follow_endpoints=False)
+        self._snapshot()
+        self._thread = threading.Thread(target=self._tail_loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def _snapshot(self) -> None:
+        body = self._client.request(_OP_SNAPSHOT, "")
+        seq, items = parse_snapshot(body)
+        self.server.install_snapshot(seq, items)
+
+    def _tail_once(self) -> None:
+        body = self._client.request(_OP_LOG_SINCE, "",
+                                    struct.pack("<q", self.seq))
+        parsed = parse_log(body)
+        if parsed is None:  # fell behind the leader's log retention
+            self._snapshot()
+            return
+        _, entries = parsed
+        for seq, op, key, payload in entries:
+            self.server.apply_mutation(seq, op, key, payload)
+
+    def _tail_loop(self) -> None:
+        while not self._stop.is_set() and not self._promoted.is_set():
+            if self._paused.is_set():
+                time.sleep(self._poll)
+                continue
+            try:
+                self._tail_once()
+                self._first_fail = None
+            except (OSError, RuntimeError):
+                # The tail client is at-most-once on LOG_SINCE, so every
+                # failure lands here; leader_lost only after the outage
+                # has spanned down_after — one dropped connection is not a
+                # dead leader.
+                now = time.monotonic()
+                if self._first_fail is None:
+                    self._first_fail = now
+                elif now - self._first_fail >= self.down_after:
+                    self.leader_lost.set()
+            self._stop.wait(self._poll)
+
+    def pause(self) -> None:
+        self._paused.set()
+
+    def resume(self) -> None:
+        self._paused.clear()
+
+    def wait_caught_up(self, seq: int, timeout: float = 10.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while self.seq < seq:
+            if time.monotonic() > deadline:
+                return False
+            time.sleep(0.01)
+        return True
+
+    def promote(self) -> Tuple[str, int]:
+        """Stop tailing; the replica server (already live) is now the
+        leader.  Returns ``(host, port)`` for the endpoints file — the
+        caller owns publishing it."""
+        self._promoted.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+        return ("127.0.0.1", self.port)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+        self.server.stop()
+
+    def __enter__(self) -> "StoreFollower":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -17,7 +17,9 @@ API (torch Store parity): ``set/get/add/wait/check/delete_key/num_keys`` plus
 
 from __future__ import annotations
 
+import collections
 import ctypes
+import json
 import os
 import socket
 import struct
@@ -25,11 +27,34 @@ import threading
 import time
 from typing import List, Optional
 
-__all__ = ["Store", "TCPStore", "FileStore", "PyTCPStoreServer"]
+__all__ = ["Store", "TCPStore", "FileStore", "PyTCPStoreServer",
+           "StoreFailoverError"]
 
 # Wire protocol op codes (must match csrc/tcpstore.cpp).
 (_OP_SET, _OP_GET, _OP_ADD, _OP_CHECK, _OP_DELETE, _OP_NUMKEYS, _OP_WAIT_GE,
  _OP_DELETE_PREFIX) = range(1, 9)
+# Replication ops — pure-Python servers only (absent from csrc/tcpstore.cpp;
+# the cluster layer forces the Python wire path when replication or endpoint
+# failover is armed, see TCPStore.__init__).
+(_OP_SNAPSHOT, _OP_LOG_SINCE) = (9, 10)
+
+
+class StoreFailoverError(ConnectionError):
+    """An at-most-once store op (SET/ADD/DELETE) was in flight while the
+    control-plane leader changed.
+
+    The op is NOT replayed against the new leader — the old leader may have
+    applied it before dying, and a blind resend would double-apply (fatal
+    for ADD-based barrier generations).  The error names both leaders and
+    the new epoch so the caller can decide whether its op is safe to
+    re-issue (idempotent re-publish: yes; counter bump: read first)."""
+
+    def __init__(self, msg: str, old: Optional[str] = None,
+                 new: Optional[str] = None, epoch: Optional[int] = None):
+        super().__init__(msg)
+        self.old_leader = old
+        self.new_leader = new
+        self.epoch = epoch
 
 
 class Store:
@@ -100,9 +125,37 @@ class Store:
 # ---------------------------------------------------------------------------
 
 class PyTCPStoreServer:
-    def __init__(self, port: int = 0):
+    """Python store server.  With ``replicate=True`` (or
+    ``TPU_DIST_STORE_REPLICATE=1``) it additionally keeps a bounded
+    in-memory mutation log that follower replicas tail via
+    ``_OP_SNAPSHOT``/``_OP_LOG_SINCE``:
+
+    - Every applied mutation gets a monotonically increasing sequence
+      number.  Only SET/DELETE/DELETE_PREFIX appear in the log — ADD is
+      logged as a SET of its *resulting* packed value, so replaying the log
+      is idempotent and order-safe (a replayed ADD would double-count).
+    - The log is bounded by entries (``TPU_DIST_STORE_LOG_MAX``) and bytes
+      (``TPU_DIST_STORE_LOG_BYTES``); a follower that asks for a sequence
+      older than the retained base is told to re-snapshot.
+    - :meth:`install_snapshot`/:meth:`apply_mutation` are the follower-side
+      entry points (tpu_dist/cluster/replica.py): they apply under the same
+      condition variable and ``notify_all``, so a blocked GET/WAIT_GE on a
+      *promoted* follower wakes exactly like one on the original leader —
+      that is the waiter re-arm guarantee.
+    """
+
+    def __init__(self, port: int = 0, replicate: bool = False):
         self._kv = {}
         self._mu = threading.Condition()
+        self._replicate = bool(replicate) or (
+            os.environ.get("TPU_DIST_STORE_REPLICATE", "") not in ("", "0"))
+        self._seq = 0  # newest applied mutation sequence number
+        self._log = collections.deque()  # (seq, op, key:str, payload:bytes)
+        self._log_bytes = 0
+        self._log_max = int(os.environ.get("TPU_DIST_STORE_LOG_MAX",
+                                           "65536"))
+        self._log_max_bytes = int(os.environ.get("TPU_DIST_STORE_LOG_BYTES",
+                                                 str(64 << 20)))
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind(("0.0.0.0", port))
@@ -167,10 +220,73 @@ class PyTCPStoreServer:
     def _reply(self, conn, status: int, data: bytes = b""):
         conn.sendall(struct.pack("<II", status, len(data)) + data)
 
+    # -- replication log (all three helpers run under self._mu) -------------
+
+    def _log_base(self) -> int:
+        return self._log[0][0] if self._log else self._seq + 1
+
+    def _log_append(self, seq: int, op: int, key: str,
+                    payload: bytes) -> None:
+        self._seq = seq
+        if not self._replicate:
+            return
+        self._log.append((seq, op, key, payload))
+        self._log_bytes += len(key) + len(payload) + 16
+        while self._log and (len(self._log) > self._log_max
+                             or self._log_bytes > self._log_max_bytes):
+            old = self._log.popleft()
+            self._log_bytes -= len(old[2]) + len(old[3]) + 16
+
+    def _log_mut(self, op: int, key: str, payload: bytes) -> None:
+        self._log_append(self._seq + 1, op, key, payload)
+
+    # -- follower-side apply (tpu_dist/cluster/replica.py) ------------------
+
+    def replication_seq(self) -> int:
+        with self._mu:
+            return self._seq
+
+    def snapshot_items(self, prefix: str = "") -> dict:
+        """Copy of the kv map (optionally filtered by key prefix) — the
+        election reads lease/candidate tables from its local replica
+        through this, never over the (dead) wire."""
+        with self._mu:
+            return {k: v for k, v in self._kv.items()
+                    if k.startswith(prefix)}
+
+    def install_snapshot(self, seq: int, items) -> None:
+        with self._mu:
+            self._kv = dict(items)
+            self._log.clear()
+            self._log_bytes = 0
+            self._seq = seq
+            self._mu.notify_all()
+
+    def apply_mutation(self, seq: int, op: int, key: str,
+                       payload: bytes) -> None:
+        with self._mu:
+            if seq <= self._seq:
+                return  # duplicate tail poll — already applied
+            if op == _OP_SET:
+                self._kv[key] = payload
+            elif op == _OP_DELETE:
+                self._kv.pop(key, None)
+            elif op == _OP_DELETE_PREFIX:
+                for k in [k for k in self._kv if k.startswith(key)]:
+                    del self._kv[k]
+            else:
+                raise ValueError(f"bad replicated op {op}")
+            # Keep the follower's own log too (with the LEADER's sequence
+            # numbers): after promotion, new mutations continue the same
+            # sequence and a future follower can tail this server in turn.
+            self._log_append(seq, op, key, payload)
+            self._mu.notify_all()
+
     def _dispatch(self, conn, op, key, payload):
         if op == _OP_SET:
             with self._mu:
                 self._kv[key] = payload
+                self._log_mut(_OP_SET, key, payload)
                 self._mu.notify_all()
             self._reply(conn, 0)
         elif op == _OP_GET:
@@ -188,6 +304,8 @@ class PyTCPStoreServer:
                 cur = self._i64(self._kv.get(key, b""))
                 nv = cur + delta
                 self._kv[key] = struct.pack("<q", nv)
+                # logged as a SET of the RESULT: replay stays idempotent
+                self._log_mut(_OP_SET, key, self._kv[key])
                 self._mu.notify_all()
             self._reply(conn, 0, struct.pack("<q", nv))
         elif op == _OP_CHECK:
@@ -197,12 +315,14 @@ class PyTCPStoreServer:
         elif op == _OP_DELETE:
             with self._mu:
                 existed = self._kv.pop(key, None) is not None
+                self._log_mut(_OP_DELETE, key, b"")
             self._reply(conn, 0, b"1" if existed else b"0")
         elif op == _OP_DELETE_PREFIX:
             with self._mu:
                 doomed = [k for k in self._kv if k.startswith(key)]
                 for k in doomed:
                     del self._kv[k]
+                self._log_mut(_OP_DELETE_PREFIX, key, b"")
             self._reply(conn, 0, struct.pack("<q", len(doomed)))
         elif op == _OP_NUMKEYS:
             with self._mu:
@@ -215,6 +335,36 @@ class PyTCPStoreServer:
                        and not self._stopping):
                     self._mu.wait(0.1)
             self._reply(conn, 1 if self._stopping else 0)
+        elif op == _OP_SNAPSHOT:
+            # atomic kv image: <q seq> <I count> then per entry
+            # <I klen> key <I vlen> value
+            with self._mu:
+                parts = [struct.pack("<qI", self._seq, len(self._kv))]
+                for k, v in self._kv.items():
+                    kb = k.encode()
+                    parts.append(struct.pack("<I", len(kb)) + kb
+                                 + struct.pack("<I", len(v)) + v)
+            self._reply(conn, 0, b"".join(parts))
+        elif op == _OP_LOG_SINCE:
+            # payload: <q since> (the follower's applied seq).  Reply body:
+            # <B flag> — flag 1 means the log was truncated past `since`
+            # (re-snapshot required); flag 0 is followed by <q leader_seq>
+            # <I count> then per entry <q seq> <B op> <I klen> key
+            # <I plen> payload.
+            since = self._i64(payload)
+            with self._mu:
+                if since + 1 < self._log_base():
+                    body = struct.pack("<B", 1)
+                else:
+                    ents = [e for e in self._log if e[0] > since]
+                    parts = [struct.pack("<BqI", 0, self._seq, len(ents))]
+                    for s, eop, ekey, epay in ents:
+                        kb = ekey.encode()
+                        parts.append(struct.pack("<qBI", s, eop, len(kb))
+                                     + kb + struct.pack("<I", len(epay))
+                                     + epay)
+                    body = b"".join(parts)
+            self._reply(conn, 0, body)
         else:
             self._reply(conn, 2)
 
@@ -300,18 +450,86 @@ _RECONNECT_ATTEMPTS = 4
 _RECONNECT_BACKOFF = 0.05  # doubles per attempt
 
 
+def _read_endpoints(path: str):
+    """Parse a cluster endpoints file → ``(host, port, epoch)`` or None.
+
+    The file (written atomically by tpu_dist/cluster/endpoints.py) names the
+    current store leader; a mid-rewrite or missing file reads as None and
+    the client keeps its current address — the next reconnect attempt
+    re-reads."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            d = json.load(f)
+        leader = str(d.get("leader") or "")
+        host, _, port = leader.rpartition(":")
+        if not host:
+            return None
+        return host, int(port), int(d.get("epoch", 0))
+    except (OSError, ValueError, TypeError):
+        return None
+
+
 class _PyClient:
     """Pure-Python client for the store wire protocol.
 
     A dropped connection (ECONNRESET, server restart, injected fault)
     mid-request is retried with bounded reconnect-and-backoff for
     idempotent ops (GET/CHECK/NUMKEYS/WAIT_GE) and surfaces as
-    ``ConnectionError`` for the at-most-once ops (SET/ADD/DELETE)."""
+    ``ConnectionError`` for the at-most-once ops (SET/ADD/DELETE).
 
-    def __init__(self, host: str, port: int, timeout: float):
+    When ``TPU_DIST_STORE_ENDPOINTS`` names an endpoints file (and
+    ``follow_endpoints`` is left on), every reconnect first re-resolves the
+    leader address from that file, so the same bounded machinery that
+    absorbs a server restart also rides out a leader *failover*: blocked
+    GET/WAIT_GE waiters re-arm against the promoted follower, and a failed
+    at-most-once op that crossed a detected leader change surfaces as
+    :class:`StoreFailoverError` (still never replayed).  The reconnect
+    budget defaults higher (8, env ``TPU_DIST_STORE_RECONNECT_ATTEMPTS``)
+    when endpoints are configured — it must cover an election window."""
+
+    def __init__(self, host: str, port: int, timeout: float,
+                 follow_endpoints: bool = True):
+        self._endpoints = (os.environ.get("TPU_DIST_STORE_ENDPOINTS") or None
+                           if follow_endpoints else None)
+        self._epoch = -1
+        if self._endpoints:
+            ep = _read_endpoints(self._endpoints)
+            if ep is not None:
+                host, port, self._epoch = ep
+        env_attempts = os.environ.get("TPU_DIST_STORE_RECONNECT_ATTEMPTS")
+        self._attempts = (int(env_attempts) if env_attempts
+                          else (8 if self._endpoints
+                                else _RECONNECT_ATTEMPTS))
         self._host, self._port = host, port
         self._sock = self._connect(host, port, timeout)
         self._mu = threading.Lock()
+
+    def _refresh_endpoints(self) -> bool:
+        """Re-resolve the leader from the endpoints file (if configured);
+        True when the address changed — a failover happened."""
+        if not self._endpoints:
+            return False
+        ep = _read_endpoints(self._endpoints)
+        if ep is None:
+            return False
+        host, port, epoch = ep
+        if (host, port) == (self._host, self._port):
+            self._epoch = max(self._epoch, epoch)
+            return False
+        old = f"{self._host}:{self._port}"
+        self._host, self._port, self._epoch = host, port, epoch
+        new = f"{host}:{port}"
+        try:  # diagnostics must never break a store op
+            from ..utils.logging import log_event
+            log_event("store-failover", old=old, new=new, epoch=epoch)
+        except Exception:
+            pass
+        try:
+            from ..obs.recorder import safe_record
+            safe_record("store", "failover", key=new, old=old, epoch=epoch)
+        except Exception:
+            pass
+        return True
 
     @staticmethod
     def _connect(host: str, port: int, timeout: float):
@@ -340,6 +558,8 @@ class _PyClient:
                + struct.pack("<I", len(payload)) + payload)
         with self._mu:
             attempt = 0
+            epoch0 = self._epoch  # leader epoch when this op started
+            old_addr = f"{self._host}:{self._port}"
             while True:
                 try:
                     self._sock.sendall(msg)
@@ -351,13 +571,26 @@ class _PyClient:
                             if dlen else b"")
                     if dlen and data is None:
                         raise ConnectionError("store connection closed")
+                    if (status == 1 and self._endpoints
+                            and op in (_OP_GET, _OP_WAIT_GE)):
+                        # "server stopping" on a blocked op.  Under a
+                        # cluster endpoints file that is a leader going
+                        # away, not a terminal answer: convert to the
+                        # retryable class so the waiter re-arms against
+                        # the promoted follower.  (Without endpoints the
+                        # historical status!=0 RuntimeError stands.)
+                        raise ConnectionError(
+                            "store stopping while blocked (leader "
+                            "shutdown) — re-arming")
                     break
                 except OSError as e:  # ConnectionError/TimeoutError included
                     if (op not in _IDEMPOTENT_OPS
-                            or attempt >= _RECONNECT_ATTEMPTS):
-                        # best-effort fresh socket so the NEXT request is not
-                        # doomed by this one's dead connection (this op is
-                        # NOT replayed: at-most-once)
+                            or attempt >= self._attempts):
+                        # best-effort fresh socket (re-resolving the leader)
+                        # so the NEXT request is not doomed by this one's
+                        # dead connection (this op is NOT replayed:
+                        # at-most-once)
+                        self._refresh_endpoints()
                         try:
                             self._sock.close()
                         except OSError:
@@ -368,11 +601,21 @@ class _PyClient:
                                                        timeout=2.0)
                         except (TimeoutError, OSError):
                             pass
+                        if self._epoch != epoch0:
+                            raise StoreFailoverError(
+                                f"store request op={op} was in flight "
+                                f"across a leader failover "
+                                f"({old_addr} -> {self._host}:{self._port}, "
+                                f"epoch {self._epoch}) and is not replayed: "
+                                f"{e}", old=old_addr,
+                                new=f"{self._host}:{self._port}",
+                                epoch=self._epoch) from e
                         raise ConnectionError(
                             f"store request op={op} failed after {attempt} "
                             f"reconnect attempt(s): {e}") from e
                     attempt += 1
                     time.sleep(_RECONNECT_BACKOFF * (2 ** (attempt - 1)))
+                    self._refresh_endpoints()
                     try:
                         self._sock.close()
                     except OSError:
@@ -567,6 +810,14 @@ class TCPStore(Store):
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  is_master: bool = False, timeout: float = 300.0):
         lib = _load_native()
+        if (os.environ.get("TPU_DIST_STORE_ENDPOINTS")
+                or os.environ.get("TPU_DIST_STORE_REPLICATE", "")
+                not in ("", "0")):
+            # Leader failover re-resolution and the replication mutation log
+            # live in the Python wire implementation only; the native
+            # client/server have neither, so a cluster-armed process must
+            # not split-brain across the two paths.
+            lib = None
         self._server = None
         self._native_server = None
         if is_master:
@@ -582,9 +833,12 @@ class TCPStore(Store):
         self.host, self.port = host, port
         self.native = lib is not None
         self._lib = lib  # close() must stop the server with the same lib
+        # A hosting instance IS the leader — it must not chase the
+        # endpoints file away from its own server.
         client = (_NativeClient(lib, host, port, timeout)
                   if lib is not None
-                  else _PyClient(host, port, timeout))
+                  else _PyClient(host, port, timeout,
+                                 follow_endpoints=not is_master))
         from ..obs import recorder as _obs_recorder
         if _obs_recorder.enabled():
             client = _ObservedClient(client)

@@ -72,6 +72,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no_store", action="store_true",
                    help="disable the control-plane store (no port "
                         "negotiation, liveness, or pre-flight)")
+    p.add_argument("--store_endpoints", type=str, default=None,
+                   metavar="PATH",
+                   help="cluster endpoints file (tpu_dist.cluster): the "
+                        "launcher and every worker resolve the store "
+                        "LEADER from this file and re-resolve it on "
+                        "reconnect, so a leader failover (node agents + "
+                        "follower replicas, python -m "
+                        "tpu_dist.cluster.agent) is transparent. With this "
+                        "flag the launcher never hosts the store itself "
+                        "unless --store_replica makes node 0 the initial "
+                        "leader")
+    p.add_argument("--store_replica", action="store_true",
+                   help="run the cluster control-plane sidecar inside the "
+                        "launcher (needs --store_endpoints): node 0 hosts "
+                        "the store with the replication log armed and "
+                        "writes the endpoints file; every other node runs "
+                        "a follower replica + node agent and can be "
+                        "elected leader if node 0's store dies")
     p.add_argument("--liveness_warn", type=float, default=60.0,
                    help="seconds before the node-0 launcher reports ranks "
                         "that have not checked in to the store")
@@ -230,6 +248,40 @@ def _setup_store(args):
 
     from ..dist.store import TCPStore
 
+    if getattr(args, "store_endpoints", None) and (
+            args.node_rank > 0 or not getattr(args, "store_replica",
+                                              False)):
+        # Cluster mode: the leader is named by the endpoints file (hosted
+        # by node agents, or by node 0's launcher under --store_replica).
+        # Every launcher connects as a client; workers inherit the
+        # endpoints env and re-resolve on reconnect — that is failover.
+        from ..cluster import endpoints as _cep
+        os.environ[_cep.ENDPOINTS_ENV] = args.store_endpoints
+        deadline = time.monotonic() + 60.0
+        addr = _cep.leader_addr(args.store_endpoints)
+        while addr is None and time.monotonic() < deadline:
+            time.sleep(0.2)
+            addr = _cep.leader_addr(args.store_endpoints)
+        if addr is None:
+            sys.stderr.write(f"no store leader appeared in "
+                             f"{args.store_endpoints!r}\n")
+            return None, None, None
+        try:
+            store = TCPStore(addr[0], addr[1], timeout=120.0)
+            if args.node_rank == 0:
+                master_port = (_free_port() if args.master_port == 0
+                               else args.master_port)
+                store.set("tpu_dist/master_port", str(master_port))
+            elif args.master_port == 0:
+                master_port = int(store.get("tpu_dist/master_port"))
+            else:
+                master_port = args.master_port
+            return store, master_port, f"{addr[0]}:{addr[1]}"
+        except Exception as e:
+            sys.stderr.write(f"store setup failed ({e!r}) against cluster "
+                             f"leader {addr[0]}:{addr[1]}\n")
+            return None, None, None
+
     try:
         if args.node_rank == 0:
             port = args.store_port or (args.master_port + 1
@@ -258,10 +310,11 @@ def _setup_store(args):
                 # generous timeout)
                 store = TCPStore(args.master_addr, port, timeout=120.0)
                 master_port = int(store.get("tpu_dist/master_port"))
-            elif args.max_restarts > 0 and args.nnodes > 1:
-                # multi-node elastic: the restart agreement rides the
-                # store from EVERY launcher, so connect even though the
-                # address is deterministic
+            elif ((args.max_restarts > 0 or args.elastic_world
+                   or args.roles) and args.nnodes > 1):
+                # multi-node elastic/roles: the restart/world (or gang
+                # round) agreement rides the store from EVERY launcher,
+                # so connect even though the address is deterministic
                 store = TCPStore(args.master_addr, port, timeout=120.0)
                 master_port = args.master_port
             else:
@@ -291,17 +344,22 @@ def _check_liveness(store, world_size: int) -> List[int]:
 
 def _spawn_world(args, world_size: int, master_port: int,
                  store_addr: Optional[str], restart_count: int,
-                 nproc: Optional[int] = None) -> List[subprocess.Popen]:
+                 nproc: Optional[int] = None,
+                 base_rank: Optional[int] = None) -> List[subprocess.Popen]:
     """Spawn this node's ranks; on partial failure kill the already-spawned
     ranks (never leave them orphaned in the rendezvous wait) and re-raise.
     ``nproc`` overrides ``--nproc_per_node`` for elastic rounds whose world
-    shrank or grew."""
+    shrank or grew; ``base_rank`` overrides the static
+    ``node_rank * nproc_per_node`` span start for rounds where the
+    cluster-wide elastic plan reassigned node spans."""
     procs: List[subprocess.Popen] = []
     if nproc is None:
         nproc = args.nproc_per_node
+    if base_rank is None:
+        base_rank = args.node_rank * args.nproc_per_node
     try:
         for local_rank in range(nproc):
-            rank = args.node_rank * args.nproc_per_node + local_rank
+            rank = base_rank + local_rank
             env = dict(os.environ,
                        RANK=str(rank),
                        LOCAL_RANK=str(local_rank),
@@ -357,7 +415,8 @@ def _diagnostic_env(args) -> Dict[str, str]:
 
 
 def _request_obs_dumps(args, procs: List[subprocess.Popen],
-                       remaining, rnd: int = 0) -> None:
+                       remaining, rnd: int = 0,
+                       base_rank: Optional[int] = None) -> None:
     """Ask still-alive workers to flush their flight recorders (SIGUSR1 ->
     tpu_dist.obs dump handler) before the TERM/KILL teardown, then wait
     (settle-bounded) for the dump files to land.  Armed runs only — a
@@ -375,14 +434,16 @@ def _request_obs_dumps(args, procs: List[subprocess.Popen],
     from ..obs.hooks import request_dumps
     from ..obs.recorder import dump_path
 
+    if base_rank is None:
+        base_rank = args.node_rank * args.nproc_per_node
     request_dumps(
-        (procs[j], dump_path(args.obs_dir, rnd,
-                             args.node_rank * args.nproc_per_node + j))
+        (procs[j], dump_path(args.obs_dir, rnd, base_rank + j))
         for j in remaining)
 
 
 def _watch_world(args, procs: List[subprocess.Popen], store,
-                 world_size: int, rnd: int = 0):
+                 world_size: int, rnd: int = 0,
+                 base_rank: Optional[int] = None):
     """Monitor one round until every rank exits → ``(exit_code,
     interrupted, rcs)``; ``interrupted`` distinguishes launcher Ctrl-C
     (never restarted) from a worker that happened to exit with code 130,
@@ -418,9 +479,14 @@ def _watch_world(args, procs: List[subprocess.Popen], store,
     interrupted = False
     t0 = time.monotonic()
     kill_deadline = None
+    if base_rank is None:
+        base_rank = args.node_rank * args.nproc_per_node
     liveness_reported = world_size <= 1 or store is None or args.node_rank != 0
-    elastic = (args.max_restarts > 0 and args.nnodes > 1
-               and store is not None)
+    # cross-node failure propagation: armed for the restart agreement AND
+    # for multi-node --elastic_world (a preemption on one node must stop
+    # the whole world so it can re-form together, restart budget or not)
+    elastic = ((args.max_restarts > 0 or args.elastic_world)
+               and args.nnodes > 1 and store is not None)
     fail_key = f"tpu_dist/elastic/fail/{rnd}"
     last_remote_check = 0.0
     remote_failed = False
@@ -476,8 +542,7 @@ def _watch_world(args, procs: List[subprocess.Popen], store,
                 if rc == 0 and monitor is not None:
                     # finished ranks are done, not lost — even if they
                     # raced past their terminal exit beat
-                    monitor.mark_done(
-                        args.node_rank * args.nproc_per_node + i)
+                    monitor.mark_done(base_rank + i)
                 if rc != 0:
                     if exit_code == 0:
                         exit_code = rc
@@ -498,7 +563,7 @@ def _watch_world(args, procs: List[subprocess.Popen], store,
             if (teardown_at is not None and not teardown_done
                     and time.monotonic() >= teardown_at):
                 teardown_done = True
-                _request_obs_dumps(args, procs, remaining, rnd)
+                _request_obs_dumps(args, procs, remaining, rnd, base_rank)
                 for j in remaining:
                     procs[j].terminate()
                 kill_deadline = time.monotonic() + kill_grace
@@ -515,7 +580,7 @@ def _watch_world(args, procs: List[subprocess.Popen], store,
                         # it into a 117 is being shut down by us, not
                         # preempted (see pre_teardown_rcs above)
                         teardown_done = True
-                        _request_obs_dumps(args, procs, remaining, rnd)
+                        _request_obs_dumps(args, procs, remaining, rnd, base_rank)
                         for j in remaining:
                             procs[j].terminate()
                         kill_deadline = time.monotonic() + kill_grace
@@ -540,7 +605,7 @@ def _watch_world(args, procs: List[subprocess.Popen], store,
                     # preemption — without this a hang would silently
                     # shrink the world instead of burning a restart
                     teardown_done = True
-                    _request_obs_dumps(args, procs, remaining, rnd)
+                    _request_obs_dumps(args, procs, remaining, rnd, base_rank)
                     for j in remaining:
                         procs[j].terminate()
                     kill_deadline = time.monotonic() + kill_grace
@@ -827,6 +892,103 @@ def _elastic_agree(args, store, rnd: int, local_rc: int,
     return ("restart", rc_port)
 
 
+def _cluster_agree(args, store, rnd: int, local_rc: int,
+                   rcs: List[Optional[int]], cur_nproc: int,
+                   restarts: int, negotiated_port: bool, master_port: int,
+                   elastic_range):
+    """Cross-launcher end-of-round agreement, world-change aware.
+
+    The multi-node generalization of :func:`_elastic_agree` (same
+    round-scoped done/fail/go keys, same budgeted-restart semantics) plus
+    the cluster elastic decision: before the done barrier every launcher
+    publishes its node's round counts (preempted 117s, grow 118s, ranks
+    run), and after it every launcher independently evaluates the SAME
+    pure plan (:func:`tpu_dist.cluster.membership.elastic_plan`) over the
+    same store-agreed counts + membership records — so all launchers agree
+    which node's ranks drop and what base rank each surviving span starts
+    at, with no coordinator and no extra votes.
+
+    Returns ``("done", 0)``, ``("giveup", rc)``,
+    ``("restart", new_master_port)`` or
+    ``("reform", (port, world, base_rank, nproc))`` — reform does NOT
+    charge the restart budget.
+    """
+    import json as _json
+
+    from ..cluster import membership as _cm
+    from ..resilience.chaos import GROW_EXIT_CODE, PREEMPTED_EXIT_CODE
+
+    prefix = "tpu_dist/elastic"
+    nnodes = args.nnodes
+    try:
+        if elastic_range is not None:
+            _cm.publish_elastic_counts(
+                store, rnd, args.node_rank, nproc=cur_nproc,
+                full_nproc=args.nproc_per_node,
+                preempted=sum(1 for rc in rcs
+                              if rc == PREEMPTED_EXIT_CODE),
+                grow=any(rc == GROW_EXIT_CODE for rc in rcs))
+        if local_rc != 0:
+            store.set(f"{prefix}/fail/{rnd}", str(args.node_rank).encode())
+        store.add(f"{prefix}/done/{rnd}", 1)
+        # an idle node (0 ranks this round) exits its watch loop instantly
+        # and must wait out the whole training phase here — unbounded,
+        # server-side blocking, not the agreement timeout
+        store.wait_value_ge(f"{prefix}/done/{rnd}", nnodes,
+                            timeout=(None if cur_nproc == 0
+                                     else args.elastic_timeout))
+        failed = local_rc != 0 or store.check(f"{prefix}/fail/{rnd}")
+        plan = None
+        if failed and elastic_range is not None:
+            counts = _cm.gather_elastic_counts(store, rnd, nnodes,
+                                               timeout=args.elastic_timeout)
+            records = _cm.read_nodes(store, nnodes)
+            plan = _cm.elastic_plan(counts, records, elastic_range[0],
+                                    elastic_range[1])
+    except Exception as e:
+        sys.stderr.write(f"[tpu_dist.launch] cluster agreement failed "
+                         f"({e!r}); giving up\n")
+        return ("giveup", local_rc or 1)
+    if not failed:
+        _elastic_exit_sync(args, store, rnd)
+        return ("done", 0)
+    if plan is None and restarts >= args.max_restarts:
+        _elastic_exit_sync(args, store, rnd)
+        return ("giveup", local_rc or 1)
+    rc_port = master_port
+    try:
+        if args.node_rank == 0:
+            if negotiated_port:
+                rc_port = _free_port()
+            _reset_round_state(store, finished_round=rnd)
+            store.set(f"{prefix}/go/{rnd}",
+                      _json.dumps({"port": rc_port,
+                                   "plan": ({str(n): list(v)
+                                             for n, v in plan.items()}
+                                            if plan else None)}).encode())
+        else:
+            store.wait([f"{prefix}/go/{rnd}"],
+                       timeout=(None if cur_nproc == 0
+                                else args.elastic_timeout))
+            go = _json.loads(store.get(f"{prefix}/go/{rnd}").decode())
+            rc_port = int(go["port"])
+            remote_plan = go.get("plan")
+            # every launcher computed the same plan from the same inputs;
+            # trusting node 0's published copy just removes any chance of
+            # a read racing a late count re-publish
+            plan = ({int(n): tuple(v) for n, v in remote_plan.items()}
+                    if remote_plan else None)
+    except Exception as e:
+        sys.stderr.write(f"[tpu_dist.launch] cluster restart handshake "
+                         f"failed ({e!r}); giving up\n")
+        return ("giveup", local_rc or 1)
+    if plan is not None:
+        base, nproc = plan.get(args.node_rank, (0, 0))
+        world = sum(np for _, np in plan.values())
+        return ("reform", (rc_port, world, base, nproc))
+    return ("restart", rc_port)
+
+
 def _run_role_graph(args) -> int:
     """``--roles``: launch a heterogeneous role graph (tpu_dist.roles)
     instead of one SPMD world.  The graph supervisor
@@ -835,11 +997,6 @@ def _run_role_graph(args) -> int:
     validates the CLI surface and assembles the worker env/argv."""
     from ..roles import RoleGraphError, parse_roles_spec, spawn_graph
 
-    if args.nnodes > 1:
-        sys.stderr.write("--roles is single-node (--nnodes=1) for now: "
-                         "multi-node role placement needs a cross-launcher "
-                         "span agreement\n")
-        return 2
     if args.no_store:
         sys.stderr.write("--roles needs the control-plane store (role map, "
                          "channels, liveness); drop --no_store\n")
@@ -857,6 +1014,16 @@ def _run_role_graph(args) -> int:
     except RoleGraphError as e:
         sys.stderr.write(f"--roles: {e}\n")
         return 2
+    if args.nnodes > 1:
+        # multi-node role placement: @node pins decide which launcher
+        # supervises which span (unpinned roles are node 0's); every
+        # launcher validates the same pins against the same cluster size
+        from ..cluster.membership import validate_placement
+        try:
+            validate_placement(graph, args.nnodes)
+        except ValueError as e:
+            sys.stderr.write(f"--roles: {e}\n")
+            return 2
     argv = [sys.executable]
     argv += ["-m", args.script] if args.module else [args.script]
     argv += args.script_args
@@ -877,20 +1044,38 @@ def _run_role_graph(args) -> int:
     store = None
     gateway_proc = None
     store_addr = None
-    if args.serve:
+    if args.nnodes > 1:
+        # shared store across launchers: node 0 hosts (or the cluster
+        # leader named by --store_endpoints serves), everyone connects —
+        # the gang round agreement rides it from every node
+        if args.store_replica:
+            os.environ["TPU_DIST_STORE_REPLICATE"] = "1"
+        store, _mp, store_addr = _setup_store(args)
+        if store is None or store_addr is None:
+            sys.stderr.write("--roles with --nnodes>1 needs a working "
+                             "control-plane store; fix the store setup "
+                             "error above\n")
+            return 2
+        if args.store_replica and args.node_rank == 0:
+            from ..cluster import endpoints as _cep
+            _cep.write_endpoints(args.store_endpoints, store_addr, 0)
+            os.environ[_cep.ENDPOINTS_ENV] = args.store_endpoints
+    if args.serve and args.node_rank == 0:
         # the serving gateway rides OUTSIDE the graph's restart loop —
         # like the SPMD path, its whole point is surviving gang rounds
         # (it re-resolves the backend registry after each restart).  Host
-        # the store here so the gateway and spawn_graph share it.
-        from ..dist.store import TCPStore
-        try:
-            store = TCPStore(args.master_addr, args.store_port,
-                             is_master=True)
-        except Exception as e:
-            sys.stderr.write(f"--roles --serve: store setup failed "
-                             f"({e})\n")
-            return 2
-        store_addr = f"{args.master_addr}:{store.port}"
+        # the store here so the gateway and spawn_graph share it (multi-
+        # node launches already hold the shared store from above).
+        if store is None:
+            from ..dist.store import TCPStore
+            try:
+                store = TCPStore(args.master_addr, args.store_port,
+                                 is_master=True)
+            except Exception as e:
+                sys.stderr.write(f"--roles --serve: store setup failed "
+                                 f"({e})\n")
+                return 2
+            store_addr = f"{args.master_addr}:{store.port}"
         gw_env = dict(os.environ, TPU_DIST_STORE_ADDR=store_addr)
         gateway_proc = subprocess.Popen(
             [sys.executable, "-m", "tpu_dist.serve", "gateway",
@@ -904,7 +1089,8 @@ def _run_role_graph(args) -> int:
                            master_addr=args.master_addr,
                            store_port=args.store_port,
                            store=store, store_addr=store_addr,
-                           extra_env=extra_env, obs_dir=args.obs_dir)
+                           extra_env=extra_env, obs_dir=args.obs_dir,
+                           node_id=args.node_rank, nnodes=args.nnodes)
     finally:
         if gateway_proc is not None and gateway_proc.poll() is None:
             gateway_proc.terminate()
@@ -948,6 +1134,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         sys.stderr.write("--max_restarts with --nnodes>1 needs the "
                          "control-plane store; drop --no_store\n")
         return 2
+    if args.store_replica and not args.store_endpoints:
+        sys.stderr.write("--store_replica needs --store_endpoints (the "
+                         "shared file clients re-resolve the leader "
+                         "from)\n")
+        return 2
+    if (args.store_endpoints or args.store_replica) and args.no_store:
+        sys.stderr.write("--store_endpoints/--store_replica need the "
+                         "control-plane store; drop --no_store\n")
+        return 2
     world_size = args.nproc_per_node * args.nnodes
     elastic_range = None
     if args.elastic_world:
@@ -961,12 +1156,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             sys.stderr.write(f"--elastic_world needs 1 <= MIN <= MAX, got "
                              f"{lo}:{hi}\n")
             return 2
-        if args.nnodes > 1:
-            # shrinking a multi-node world needs a cross-launcher
-            # agreement on WHICH node drops ranks; single-node covers the
-            # preemption story the chaos e2e proves
-            sys.stderr.write("--elastic_world is single-node "
-                             "(--nnodes=1) for now\n")
+        if args.nnodes > 1 and hi != args.nproc_per_node * args.nnodes:
+            # the cluster grow decision restores each node to its
+            # configured capacity, so MAX must be the full static world —
+            # anything else would silently cap growth below what the
+            # flags promise
+            sys.stderr.write(f"--elastic_world MAX must equal "
+                             f"nproc_per_node*nnodes "
+                             f"({args.nproc_per_node * args.nnodes}) with "
+                             f"--nnodes>1, got {hi}\n")
             return 2
         if args.no_store:
             # generation fencing + the reshard visibility exchange ride
@@ -993,20 +1191,61 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.roles:
         return _run_role_graph(args)
 
+    if args.store_replica:
+        # replication must be armed BEFORE the store is hosted (node 0's
+        # server owns the mutation log) and forces the Python wire path
+        # everywhere in this process tree
+        os.environ["TPU_DIST_STORE_REPLICATE"] = "1"
     store, master_port, store_addr = _setup_store(args)
     if master_port is None:
         return 2
     negotiated_port = args.master_port == 0
+    cluster_agent = None
+    cluster_follower = None
+    if args.store_replica and store is not None:
+        from ..cluster import NodeAgent, StoreFollower
+        from ..cluster import endpoints as _cep
+        try:
+            if args.node_rank == 0:
+                # this launcher's store IS the initial leader
+                _cep.write_endpoints(args.store_endpoints, store_addr, 0)
+                os.environ[_cep.ENDPOINTS_ENV] = args.store_endpoints
+                cluster_agent = NodeAgent(0, args.store_endpoints,
+                                          nproc=args.nproc_per_node)
+                cluster_agent.is_leader.set()
+                cluster_agent.start()
+            else:
+                addr = _cep.leader_addr(args.store_endpoints)
+                cluster_follower = StoreFollower(addr[0], addr[1]).start()
+                cluster_agent = NodeAgent(args.node_rank,
+                                          args.store_endpoints,
+                                          follower=cluster_follower,
+                                          nproc=args.nproc_per_node)
+                cluster_agent.start()
+        except Exception as e:
+            sys.stderr.write(f"--store_replica: cluster sidecar setup "
+                             f"failed ({e!r})\n")
+            return 2
+    elif (args.nnodes > 1 and store is not None
+          and (elastic_range or args.max_restarts > 0)):
+        # membership record for the cluster elastic plan (host-fingerprint
+        # node ordering) even without the replication sidecar
+        try:
+            from ..cluster.membership import register_node
+            register_node(store, args.node_rank, args.nproc_per_node)
+        except Exception:
+            pass
 
-    multi_node_elastic = args.max_restarts > 0 and args.nnodes > 1
-    if multi_node_elastic and store is None:
+    multi_node = (args.nnodes > 1
+                  and (args.max_restarts > 0 or elastic_range is not None))
+    if multi_node and store is None:
         # store setup failed above (warning already printed): without it
         # there is no cross-node failure propagation or restart agreement
         # — refuse rather than silently run non-elastic and then exit 1
         # from a doomed agreement
-        sys.stderr.write("--max_restarts with --nnodes>1 needs a working "
-                         "control-plane store; fix the store setup error "
-                         "above or drop --max_restarts\n")
+        sys.stderr.write("--max_restarts/--elastic_world with --nnodes>1 "
+                         "needs a working control-plane store; fix the "
+                         "store setup error above or drop the flag\n")
         return 2
     # --serve: the gateway role is spawned ONCE, outside the restart loop
     # — its whole point is surviving worker relaunches (it re-resolves the
@@ -1032,31 +1271,55 @@ def main(argv: Optional[List[str]] = None) -> int:
     #                budget in the first place
     cur_world = world_size
     cur_nproc = args.nproc_per_node
+    base_rank = args.node_rank * args.nproc_per_node
     try:
         while True:
             if store is not None and args.node_rank == 0:
                 _publish_generation(store, rnd)
             procs = _spawn_world(args, cur_world, master_port, store_addr,
-                                 rnd, nproc=cur_nproc)
+                                 rnd, nproc=cur_nproc, base_rank=base_rank)
             exit_code, interrupted, rcs = _watch_world(args, procs, store,
-                                                       cur_world, rnd=rnd)
+                                                       cur_world, rnd=rnd,
+                                                       base_rank=base_rank)
             if interrupted:
                 return exit_code
             if exit_code != 0 and args.node_rank == 0:
                 # before any reaping: the tails live under the failed
                 # generation's keyspace
                 _report_obs(args, store, cur_world, rnd)
-            if multi_node_elastic:
+            if multi_node:
                 # group decision: even a node whose workers all exited 0
-                # must wait — a peer's failure restarts everyone
-                # (rnd == restarts here: --elastic_world is single-node)
-                verdict, val = _elastic_agree(args, store, rnd,
-                                              exit_code, negotiated_port,
-                                              master_port)
+                # (or an idle node running none this round) must wait — a
+                # peer's failure restarts everyone, a peer's preemption or
+                # grow re-forms the world for everyone
+                verdict, val = _cluster_agree(args, store, rnd, exit_code,
+                                              rcs, cur_nproc, restarts,
+                                              negotiated_port, master_port,
+                                              elastic_range)
                 if verdict == "done":
                     return 0
                 if verdict == "giveup":
                     return val
+                if verdict == "reform":
+                    # cluster elastic re-form: world size and/or rank
+                    # placement changed (the plan may drop THIS node to 0
+                    # ranks — it idles in the agreement until a grow).
+                    # Not a failure restart: budget untouched, generation
+                    # still advances (same contract as single-node).
+                    master_port, new_world, base_rank, cur_nproc = val
+                    rnd += 1
+                    sys.stderr.write(
+                        f"[tpu_dist.launch] cluster elastic re-form: "
+                        f"world {cur_world} -> {new_world}, node "
+                        f"{args.node_rank} runs {cur_nproc} rank(s) from "
+                        f"base {base_rank} (generation {rnd}; restart "
+                        f"budget untouched at "
+                        f"{restarts}/{args.max_restarts})\n")
+                    if args.node_rank == 0:
+                        _report_reshard_plan(store, new_world)
+                    cur_world = new_world
+                    _restart_backoff(args, 1)
+                    continue
                 master_port = val
                 restarts += 1
                 rnd += 1
@@ -1118,6 +1381,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                 # env — no store re-publication needed
                 master_port = _free_port()
     finally:
+        if cluster_agent is not None:
+            try:
+                cluster_agent.stop()
+            except Exception:
+                pass
+        if cluster_follower is not None:
+            try:
+                cluster_follower.stop()
+            except Exception:
+                pass
         if gateway_proc is not None and gateway_proc.poll() is None:
             gateway_proc.terminate()
             try:

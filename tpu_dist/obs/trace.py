@@ -176,6 +176,20 @@ def diagnose(dumps: List[dict]) -> dict:
                     "prompt_len": e.get("prompt_len"),
                     "site": e.get("site")})
     out["stuck_requests"] = stuck_requests
+    # control-plane leader changes: a client that rode a store failover
+    # records kind="store" op="failover" naming the promoted leader —
+    # surface them so an operator reading a hang/restart diagnosis can see
+    # the control plane moved under the job (and where it moved TO)
+    store_failovers = []
+    for dmp in dumps:
+        for e in dmp.get("events", []):
+            if e.get("kind") == "store" and e.get("op") == "failover":
+                store_failovers.append({
+                    "rank": dmp.get("rank", 0),
+                    "leader": e.get("key"),
+                    "old": e.get("old"),
+                    "epoch": e.get("epoch")})
+    out["store_failovers"] = store_failovers
     stuck_ref = ranks[waiting[0]] if waiting else None
     if front < 0:
         out.update({"verdict": "no-collectives", "straggler": None})
@@ -269,6 +283,14 @@ def render_diagnosis(d: dict) -> str:
                if sr.get("prompt_len") is not None else "")
             + ") never completed"
             + (f" — submitted at {sr['site']}" if sr.get("site") else ""))
+    failovers = d.get("store_failovers") or []
+    if failovers:
+        latest = max(failovers, key=lambda f: f.get("epoch") or 0)
+        seen = sorted({f["rank"] for f in failovers})
+        lines.append(
+            f"  store failover: leader {latest.get('old')} lost; clients "
+            f"re-resolved to promoted leader {latest.get('leader')} "
+            f"(epoch {latest.get('epoch')}) — observed by rank(s) {seen}")
     for r in sorted(d.get("ranks", {})):
         lines.append(_rank_line(r, d["ranks"][r]))
     return "\n".join(lines)

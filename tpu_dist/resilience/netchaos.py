@@ -36,7 +36,11 @@ same failure on every run):
 =================  ==========================================================
 
 Scoping params (all optional): ``rank=`` the *sending* rank, ``peer=`` the
-destination rank, ``surface=`` one of ``tcp`` (data-plane frame), ``shm``
+destination rank, ``node=`` the node this process runs on (``NODE_RANK``
+/ ``TPU_DIST_NODE_ID`` env) — the node-granularity partition cell:
+``partition:surface=store,node=1`` blackholes the store wire for EVERY
+process on node 1 and nothing anywhere else, the shape of a top-of-rack
+switch death; ``surface=`` one of ``tcp`` (data-plane frame), ``shm``
 (shared-memory lane payload), ``store`` (control-plane client request),
 ``serve`` (serving wire frame); ``frame=`` the 1-based index of the
 matching frame/op at which the fault fires (persistent kinds stay armed
@@ -90,6 +94,7 @@ class NetFault:
     kind: str
     rank: Optional[int] = None     # sending rank (None = every rank)
     peer: Optional[int] = None     # destination rank (None = every peer)
+    node: Optional[int] = None     # this process's node (None = every node)
     surface: Optional[str] = None  # tcp | shm | store | serve (None = all)
     frame: int = 1                 # 1-based matching-frame trigger index
     delay: float = 0.0             # delay kind
@@ -126,7 +131,7 @@ def parse(spec: str) -> List[NetFault]:
                 raise ValueError(f"malformed netchaos param {kv!r} in "
                                  f"{part!r} (expected key=value)")
             k = k.strip()
-            if k in ("rank", "peer", "frame", "flips", "seed"):
+            if k in ("rank", "peer", "node", "frame", "flips", "seed"):
                 kwargs[k] = int(v)
             elif k in ("delay", "rate"):
                 kwargs[k] = float(v)
@@ -151,10 +156,19 @@ class NetChaos:
     store/serve clients issue requests in program order.
     """
 
-    def __init__(self, faults: List[NetFault], rank: Optional[int] = None):
+    def __init__(self, faults: List[NetFault], rank: Optional[int] = None,
+                 node: Optional[int] = None):
         self.faults = list(faults)
         self.rank = (rank if rank is not None
                      else int(os.environ.get("RANK", "0") or 0))
+        if node is None:
+            raw = (os.environ.get("NODE_RANK")
+                   or os.environ.get("TPU_DIST_NODE_ID"))
+            node = int(raw) if raw not in (None, "") else None
+        # a node= fault on a process with NO node identity stays disarmed:
+        # firing it everywhere would turn a one-cell partition into a
+        # cluster-wide outage the spec never asked for
+        self.node = node
         self._mu = threading.Lock()
         self._counts = [0] * len(self.faults)
         self._fired = [False] * len(self.faults)
@@ -162,6 +176,8 @@ class NetChaos:
     def _matches(self, f: NetFault, surface: str, src: Optional[int],
                  dst: Optional[int]) -> bool:
         if f.surface is not None and f.surface != surface:
+            return False
+        if f.node is not None and f.node != self.node:
             return False
         who = src if src is not None else self.rank
         if f.rank is not None and f.rank != who:

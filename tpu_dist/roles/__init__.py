@@ -26,7 +26,7 @@ from .channel import (Channel, ChannelClosedError, ChannelError,
 from .graph import (ChannelSpec, Role, RoleGraph, RoleGraphError,
                     current_graph, current_role, parse_roles_spec,
                     role_label)
-from .launcher import spawn_graph
+from .launcher import local_ranks_of, spawn_graph
 from .runtime import RoleContext, init_role_graph
 
 __all__ = ["Role", "ChannelSpec", "RoleGraph", "RoleGraphError",
@@ -34,4 +34,5 @@ __all__ = ["Role", "ChannelSpec", "RoleGraph", "RoleGraphError",
            "role_label",
            "Channel", "ChannelError", "ChannelClosedError",
            "ChannelTimeoutError", "ChannelPeerGoneError",
-           "RoleContext", "init_role_graph", "spawn_graph"]
+           "RoleContext", "init_role_graph", "spawn_graph",
+           "local_ranks_of"]

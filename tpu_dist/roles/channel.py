@@ -54,7 +54,12 @@ supervisor's *down* markers and the peer side's *closed* counters:
   consumer acks it and moves on instead of re-claiming it forever;
   multi-consumer endpoints remember their abandoned claims and later
   gets deliver a late write or ack the settled hole
-  (``roles-channel-hole-skipped`` log event).  A data-plane frame
+  (``roles-channel-hole-skipped`` log event); each consumer rank also
+  persists its outstanding claims (``claims/{rank}``), so a
+  solo-respawned consumer inherits the dead incarnation's orphaned
+  claims into the same ledger and reconciles them
+  (``roles-channel-claims-reconciled``) instead of leaking the
+  backpressure window.  A data-plane frame
   timeout under a fetched envelope is *retryable*: the envelope and
   claim are returned so the same slot delivers once frames land.
 - :class:`ChannelPeerGoneError` — every rank of the peer role is marked
@@ -204,6 +209,7 @@ class Channel:
             self._store.delete_key(self._k(f"closed/{self._rank}"))
         except Exception:
             pass
+        self._claims: set = set()  # this rank's outstanding MPMC claims
         if (spec.kind == "queue" and self._role == spec.dst
                 and self._dst == [self._rank]):
             # the claim-orphan rewind, the consumer twin of hole healing:
@@ -218,6 +224,34 @@ class Channel:
                 stranded = self._count("rtail") - self._count("acks")
                 if stranded > 0:
                     self._store.add(self._k("rtail"), -stranded)
+            except Exception:
+                pass
+        elif (spec.kind == "queue" and self._role == spec.dst
+                and len(self._dst) != 1):
+            # multi-consumer claim-orphan reconciliation: claims cannot be
+            # returned (a sibling may have claimed past us), so each
+            # consumer rank persists its outstanding claims under
+            # claims/{rank}; a solo-respawned incarnation inherits the
+            # dead one's claims into the abandoned-claim ledger, where
+            # later gets deliver a late write or settle-ack the hole —
+            # instead of those slots leaking the backpressure window for
+            # the rest of the generation
+            try:
+                raw = (self._store.get(self._k(f"claims/{self._rank}"))
+                       if self._store.check(
+                           self._k(f"claims/{self._rank}")) else b"[]")
+                import json
+                inherited = [int(i) for i in json.loads(raw.decode())]
+                for i in inherited:
+                    # settle clock deferred (entry[0]=None): the sweep
+                    # starts it once a producer has claimed the slot
+                    self._abandoned.setdefault(i, [None, _hole_settle()])
+                    self._claims.add(i)
+                if inherited:
+                    from ..utils.logging import log_event
+                    log_event("roles-channel-claims-reconciled",
+                              channel=self.name, rank=self._rank,
+                              slots=sorted(inherited))
             except Exception:
                 pass
         if (spec.kind == "queue" and self._dp is None
@@ -316,6 +350,30 @@ class Channel:
             self._next_status = now + 0.1
         return self._status_cache
 
+    def _claim_add(self, idx: int) -> None:
+        """Persist a multi-consumer claim (crash ledger: a killed
+        incarnation's successor inherits these — see ``__init__``).
+        Best-effort: a flaky store degrades recovery, never delivery."""
+        self._claims.add(idx)
+        self._claims_persist()
+
+    def _claim_done(self, idx: int) -> None:
+        """The claim on ``idx`` is resolved (delivered, poison-consumed or
+        settle-acked) — drop it from the persisted ledger."""
+        if idx in self._claims:
+            self._claims.discard(idx)
+            self._claims_persist()
+
+    def _claims_persist(self) -> None:
+        if len(self._dst) == 1 or self._role != self.spec.dst:
+            return
+        import json
+        try:
+            self._store.set(self._k(f"claims/{self._rank}"),
+                            json.dumps(sorted(self._claims)).encode())
+        except Exception:
+            pass
+
     def _consume_slot(self, idx: int, key: str) -> None:
         """Ack + delete a slot whose message is consumed by failure
         (poison decode, lossy multi-consumer timeout) — best-effort, so a
@@ -326,6 +384,7 @@ class Channel:
             self._store.add(self._k("acks"), 1)
         except Exception:
             pass
+        self._claim_done(idx)
 
     def _deadline(self, timeout: Optional[float]) -> float:
         t = _default_timeout() if timeout is None else float(timeout)
@@ -527,6 +586,8 @@ class Channel:
             if got is not _NOTHING:
                 return got
         idx = int(self._store.add(self._k("rtail"), 1)) - 1
+        if len(self._dst) != 1:
+            self._claim_add(idx)
         key = self._k(f"m/{idx}")
         delay = 0.0005
         while True:
@@ -605,6 +666,7 @@ class Channel:
         self._store.delete_key(key)
         self._store.add(self._k("acks"), 1)
         self._stuck.pop(idx, None)
+        self._claim_done(idx)
         self.stats["got"] += 1
         return out
 
@@ -699,6 +761,7 @@ class Channel:
             if now - entry[0] >= entry[1]:
                 self._abandoned.pop(idx, None)
                 self._store.add(self._k("acks"), 1)
+                self._claim_done(idx)
                 from ..utils.logging import log_event
                 log_event("roles-channel-hole-skipped", channel=self.name,
                           slot=idx)

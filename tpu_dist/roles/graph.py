@@ -86,11 +86,19 @@ class Role:
       every other role keeps running and store-backed channels resume by
       name (the actor/rollout-worker policy: producers are stateless
       between messages).
+
+    ``node`` is the optional placement pin (``actor:4@1`` in the launcher
+    grammar): all of the role's ranks run on that node of a multi-launcher
+    cluster.  ``None`` means node 0 — placement must be deterministic
+    across launchers, so an unpinned role cannot float.  Validated against
+    the actual cluster size by
+    :func:`tpu_dist.cluster.membership.validate_placement`.
     """
     name: str
     world: int
     restart: str = "gang"
     entry: Optional[str] = None   # per-role entrypoint override (launcher)
+    node: Optional[int] = None    # placement pin (None -> node 0)
 
     def __post_init__(self):
         _check_name("role", self.name)
@@ -102,6 +110,11 @@ class Role:
             raise RoleGraphError(
                 f"role {self.name!r}: restart policy {self.restart!r} "
                 f"must be one of {_RESTART_POLICIES}")
+        if self.node is not None and (not isinstance(self.node, int)
+                                      or self.node < 0):
+            raise RoleGraphError(
+                f"role {self.name!r}: node pin {self.node!r} must be a "
+                f"non-negative node id")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -235,14 +248,17 @@ class RoleGraph:
     # -- serialization -------------------------------------------------------
 
     def spec_string(self) -> str:
-        """The launcher grammar: ``learner:1,actor:4:solo`` (restart
-        policy only when non-default; channels do not travel here — they
-        are the *program*'s literal, validated against this map)."""
+        """The launcher grammar: ``learner:1,actor:4:solo@1`` (restart
+        policy and ``@node`` pin only when non-default; channels do not
+        travel here — they are the *program*'s literal, validated against
+        this map)."""
         parts = []
         for r in self.roles:
             s = f"{r.name}:{r.world}"
             if r.restart != "gang":
                 s += f":{r.restart}"
+            if r.node is not None:
+                s += f"@{r.node}"
             parts.append(s)
         return ",".join(parts)
 
@@ -250,7 +266,8 @@ class RoleGraph:
         return json.dumps({
             "version": 1,
             "roles": [{"name": r.name, "world": r.world,
-                       "restart": r.restart} for r in self.roles],
+                       "restart": r.restart, "node": r.node}
+                      for r in self.roles],
             "channels": [dataclasses.asdict(c) for c in self.channels],
         }, sort_keys=True)
 
@@ -258,7 +275,9 @@ class RoleGraph:
     def from_json(cls, raw) -> "RoleGraph":
         doc = json.loads(raw if isinstance(raw, str) else raw.decode())
         return cls([Role(r["name"], int(r["world"]),
-                         restart=r.get("restart", "gang"))
+                         restart=r.get("restart", "gang"),
+                         node=(int(r["node"])
+                               if r.get("node") is not None else None))
                     for r in doc["roles"]],
                    [ChannelSpec(**c) for c in doc.get("channels", ())])
 
@@ -285,10 +304,10 @@ class RoleGraph:
 
 
 def parse_roles_spec(spec: str) -> RoleGraph:
-    """Parse the launcher grammar ``name:world[:policy][,...]`` (e.g.
-    ``learner:1,actor:4:solo``) into a channel-less :class:`RoleGraph`.
-    Raises :class:`RoleGraphError` on malformed specs, naming the bad
-    segment."""
+    """Parse the launcher grammar ``name:world[:policy][@node][,...]``
+    (e.g. ``learner:1,actor:4:solo@1``) into a channel-less
+    :class:`RoleGraph`.  Raises :class:`RoleGraphError` on malformed
+    specs, naming the bad segment."""
     if not spec or not spec.strip():
         raise RoleGraphError("empty --roles spec")
     roles = []
@@ -296,11 +315,20 @@ def parse_roles_spec(spec: str) -> RoleGraph:
         part = part.strip()
         if not part:
             raise RoleGraphError(f"empty role segment in {spec!r}")
-        bits = part.split(":")
+        part_body, at, node_str = part.partition("@")
+        node = None
+        if at:
+            try:
+                node = int(node_str)
+            except ValueError:
+                raise RoleGraphError(
+                    f"role segment {part!r}: node pin {node_str!r} is "
+                    f"not an integer") from None
+        bits = part_body.split(":")
         if len(bits) not in (2, 3):
             raise RoleGraphError(
-                f"role segment {part!r} must be name:world[:policy] "
-                f"(e.g. 'actor:4:solo')")
+                f"role segment {part!r} must be name:world[:policy][@node] "
+                f"(e.g. 'actor:4:solo@1')")
         name = bits[0].strip()
         try:
             world = int(bits[1])
@@ -309,7 +337,7 @@ def parse_roles_spec(spec: str) -> RoleGraph:
                 f"role segment {part!r}: world {bits[1]!r} is not an "
                 f"integer") from None
         restart = bits[2].strip() if len(bits) == 3 else "gang"
-        roles.append(Role(name, world, restart=restart))
+        roles.append(Role(name, world, restart=restart, node=node))
     return RoleGraph(roles)
 
 

@@ -48,9 +48,28 @@ from typing import Dict, List, Optional, Sequence
 
 from .graph import RoleGraph, down_key, map_key
 
-__all__ = ["spawn_graph"]
+__all__ = ["spawn_graph", "local_ranks_of"]
 
 _KILL_GRACE = 15.0
+# bound on the cross-launcher round agreement when THIS node already
+# failed (peers tear down within ~one poll interval + kill grace, so a
+# peer missing past this is a vanished machine, not a slow one)
+_AGREE_TIMEOUT = 120.0
+# cross-launcher gang coordination keys: cluster-scoped (TD003-allowlisted
+# under tpu_dist/cluster) but round-suffixed, so rounds never race
+_ROLES_PREFIX = "tpu_dist/cluster/roles"
+
+
+def local_ranks_of(graph: RoleGraph, node_id: int) -> List[int]:
+    """The global ranks node ``node_id`` runs: every rank of every role
+    pinned there (``@node`` in the spec; unpinned roles are node 0's —
+    placement must be deterministic across launchers, so nothing
+    floats)."""
+    out: List[int] = []
+    for r in graph.roles:
+        if (r.node if r.node is not None else 0) == node_id:
+            out.extend(graph.span(r.name))
+    return out
 
 
 def _log(msg: str) -> None:
@@ -92,6 +111,19 @@ def _settle_obs_dumps(obs_dir: Optional[str], rnd: int,
     request_dumps((procs[r], dump_path(obs_dir, rnd, r)) for r in ranks)
 
 
+def _exit_sync(store, rnd: int, node_id: int, nnodes: int) -> None:
+    """Final ack before launchers leave the multi-node graph protocol:
+    node 0 usually hosts the store, so it must not return (tearing the
+    server down) while a peer is still polling the round's verdict."""
+    try:
+        key = f"{_ROLES_PREFIX}/exit/{rnd}"
+        store.add(key, 1)
+        if node_id == 0:
+            store.wait_value_ge(key, nnodes, timeout=15.0)
+    except Exception:
+        pass  # best effort: worst case is a noisier peer error path
+
+
 def _teardown(procs: Dict[int, subprocess.Popen]) -> None:
     """TERM everything still running, escalate to KILL after the grace."""
     for p in procs.values():
@@ -116,7 +148,8 @@ def spawn_graph(graph: RoleGraph, argv: Sequence[str],
                 store=None, store_addr: Optional[str] = None,
                 master_addr: str = "127.0.0.1", store_port: int = 0,
                 extra_env: Optional[Dict[str, str]] = None,
-                obs_dir: Optional[str] = None) -> int:
+                obs_dir: Optional[str] = None,
+                node_id: int = 0, nnodes: int = 1) -> int:
     """Launch and supervise ``graph``; returns the graph's exit code
     (0 = every rank exited cleanly).  ``argv`` is the worker command
     (e.g. ``[sys.executable, "worker.py", ...]``); ``role_argv`` maps a
@@ -125,17 +158,34 @@ def spawn_graph(graph: RoleGraph, argv: Sequence[str],
     ``max_restarts`` budgets GANG restarts (generation advances);
     ``solo_restarts`` budgets per-rank solo respawns of ``restart="solo"``
     roles within one generation.  See the module docstring for the env
-    contract and policy semantics."""
+    contract and policy semantics.
+
+    Multi-node (``nnodes > 1``): every node's launcher calls this with its
+    ``node_id``, a SHARED ``store``/``store_addr``, and the same graph —
+    each supervises only :func:`local_ranks_of` its node (the ``@node``
+    pins).  Gang semantics stay global: a gang-policy death anywhere posts
+    ``tpu_dist/cluster/roles/fail/{rnd}``, every launcher tears down its
+    span, and the round outcome (give up vs next generation) is agreed at
+    a cross-launcher barrier before anyone advances.  Solo respawns stay
+    node-local.  All launchers must run the same restart budgets."""
     if max_restarts < 0 or solo_restarts < 0:
         raise ValueError("restart budgets must be >= 0")
+    if not 0 <= node_id < nnodes:
+        raise ValueError(f"node_id {node_id} out of range for nnodes "
+                         f"{nnodes}")
     owns_store = store is None
     if owns_store:
+        if nnodes > 1 and node_id > 0:
+            raise ValueError("multi-node spawn_graph needs the shared "
+                             "store= / store_addr= on every non-zero node")
         from ..dist.store import TCPStore
         store = TCPStore(master_addr, store_port, is_master=True)
         store_addr = f"{master_addr}:{store.port}"
     elif store_addr is None:
         raise ValueError("spawn_graph(store=...) needs store_addr= too "
                          "(the address workers dial)")
+    my_ranks = (list(range(graph.world)) if nnodes == 1
+                else local_ranks_of(graph, node_id))
 
     spec = graph.spec_string()
     role_argv = dict(role_argv or {})
@@ -167,28 +217,33 @@ def spawn_graph(graph: RoleGraph, argv: Sequence[str],
     gang_restarts = 0
     try:
         while True:
-            store.set("tpu_dist/generation", str(rnd))
-            store.set(map_key(rnd), graph.to_json())
+            if node_id == 0:
+                store.set("tpu_dist/generation", str(rnd))
+                store.set(map_key(rnd), graph.to_json())
             procs: Dict[int, subprocess.Popen] = {}
-            incarnation = {r: 0 for r in range(graph.world)}
-            solo_budget = {r: solo_restarts for r in range(graph.world)}
+            incarnation = {r: 0 for r in my_ranks}
+            solo_budget = {r: solo_restarts for r in my_ranks}
             try:
-                for r in range(graph.world):
+                for r in my_ranks:
                     procs[r] = _spawn_rank(r, rnd, 0)
             except BaseException:
                 _teardown(procs)
                 raise
             monitor = None
-            if heartbeat_timeout > 0:
+            if heartbeat_timeout > 0 and my_ranks:
                 from ..resilience.heartbeat import HeartbeatMonitor
                 monitor = HeartbeatMonitor(store, graph.world,
                                            timeout=heartbeat_timeout,
-                                           generation=rnd)
+                                           generation=rnd,
+                                           ranks=(my_ranks if nnodes > 1
+                                                  else None))
             exit_code = 0
             done: set = set()
             last_hb = 0.0
+            last_remote = 0.0
+            fail_key = f"{_ROLES_PREFIX}/fail/{rnd}"
             try:
-                while len(done) < graph.world and exit_code == 0:
+                while len(done) < len(my_ranks) and exit_code == 0:
                     for r, p in procs.items():
                         if r in done:
                             continue
@@ -229,6 +284,8 @@ def spawn_graph(graph: RoleGraph, argv: Sequence[str],
                              + " — failing the gang round")
                         try:
                             store.set(down_key(rnd, r), b"1")
+                            if nnodes > 1:
+                                store.set(fail_key, str(node_id).encode())
                         except Exception:
                             pass
                         break
@@ -265,10 +322,27 @@ def spawn_graph(graph: RoleGraph, argv: Sequence[str],
                                 exit_code = 1
                                 try:
                                     store.set(down_key(rnd, r), b"1")
+                                    if nnodes > 1:
+                                        store.set(fail_key,
+                                                  str(node_id).encode())
                                 except Exception:
                                     pass
                             break
-                    if len(done) < graph.world and exit_code == 0:
+                    if (nnodes > 1 and exit_code == 0
+                            and time.monotonic() - last_remote > 0.5):
+                        # a gang-policy death on ANY node fails the round
+                        # everywhere: poll the round's cluster fail key and
+                        # tear down this node's span on sight
+                        last_remote = time.monotonic()
+                        try:
+                            if store.check(fail_key):
+                                exit_code = 1
+                                _log(f"gang failure reported by another "
+                                     f"node (round {rnd}); stopping "
+                                     f"node {node_id}'s ranks")
+                        except Exception:
+                            pass
+                    if len(done) < len(my_ranks) and exit_code == 0:
                         time.sleep(0.05)
             except BaseException:
                 # a respawn/store failure inside supervision must not
@@ -276,11 +350,65 @@ def spawn_graph(graph: RoleGraph, argv: Sequence[str],
                 # as the initial per-round spawn above
                 _teardown(procs)
                 raise
-            if exit_code == 0:
+            if exit_code == 0 and nnodes == 1:
                 return 0
-            _settle_obs_dumps(obs_dir, rnd, procs,
-                              [r for r in procs if r not in done])
-            _teardown(procs)
+            if exit_code != 0:
+                _settle_obs_dumps(obs_dir, rnd, procs,
+                                  [r for r in procs if r not in done])
+                _teardown(procs)
+            if nnodes > 1:
+                # cross-launcher round agreement: every node arrives at the
+                # done barrier (success and failure alike — a peer's gang
+                # failure must restart this node too), then all act on the
+                # same verdict in lockstep.  A node whose span finished
+                # clean waits unbounded: its peers may legitimately train
+                # for hours; failed rounds converge within the teardown
+                # grace, so THOSE waits are bounded.
+                try:
+                    if exit_code != 0:
+                        store.set(fail_key, str(node_id).encode())
+                    done_k = f"{_ROLES_PREFIX}/done/{rnd}"
+                    store.add(done_k, 1)
+                    store.wait_value_ge(
+                        done_k, nnodes,
+                        timeout=(None if exit_code == 0
+                                 else _AGREE_TIMEOUT))
+                    failed = exit_code != 0 or store.check(fail_key)
+                except Exception as e:
+                    _log(f"cross-launcher round agreement failed ({e!r}); "
+                         f"giving up")
+                    return exit_code or 1
+                if not failed:
+                    _exit_sync(store, rnd, node_id, nnodes)
+                    return 0
+                if exit_code == 0:
+                    # our span finished clean but a peer's gang failed
+                    # AFTER our done arrival — fail the round here too
+                    exit_code = 1
+                if gang_restarts >= max_restarts:
+                    _exit_sync(store, rnd, node_id, nnodes)
+                    return exit_code
+                gang_restarts += 1
+                _log(f"gang round {rnd} failed (rc={exit_code}); gang "
+                     f"restart {gang_restarts}/{max_restarts} agreed "
+                     f"across {nnodes} nodes — generation advances")
+                try:
+                    go_k = f"{_ROLES_PREFIX}/go/{rnd}"
+                    if node_id == 0:
+                        _reset_round_state(store, rnd)
+                        store.set(go_k, b"1")
+                    else:
+                        # spawn only after node 0's control-plane reset
+                        store.wait([go_k], timeout=_AGREE_TIMEOUT)
+                except Exception as e:
+                    _log(f"cross-launcher restart handshake failed "
+                         f"({e!r}); giving up")
+                    return exit_code
+                rnd += 1
+                if restart_backoff > 0:
+                    time.sleep(min(restart_backoff
+                                   * 2 ** (gang_restarts - 1), 10.0))
+                continue
             if gang_restarts >= max_restarts:
                 return exit_code
             gang_restarts += 1
