@@ -723,6 +723,336 @@ def run_sharded(smoke: bool = False, write_json: bool = True) -> dict:
     return summary
 
 
+# ---------------------------------------------------------------------------
+# disaggregated prefill/decode rows (ISSUE 17) — BENCH_SERVE_DISAGG.json
+# ---------------------------------------------------------------------------
+
+
+class _DisaggRig:
+    """In-process disaggregated stack: one decode pool + ``n_prefill``
+    prefill worker threads over store-only typed channels and real
+    data-plane KV frames — the test-rig layout (production ranks are
+    separate launcher processes, examples/serve_lm.py --disagg)."""
+
+    def __init__(self, model, params, max_len: int, slots: int,
+                 prefix=None, step_hook=None, batch_window: float = 0.002,
+                 n_prefill: int = 1):
+        from tpu_dist import serve
+        from tpu_dist.dist.store import TCPStore
+        from tpu_dist.collectives.transport import DataPlane
+        from tpu_dist.roles.channel import Channel
+
+        graph = serve.disagg_graph(n_prefill, 1)
+        world = graph.world
+        self.store = TCPStore(is_master=True)
+        self.dps = [DataPlane(self.store, r, world) for r in range(world)]
+        self._chans = []
+
+        def chan(name, rank):
+            spec = graph.channel_spec(name)
+            role, _ = graph.role_of(rank)
+            ch = Channel(spec, self.store, rank, role,
+                         src_span=list(graph.span(spec.src)),
+                         dst_span=list(graph.span(spec.dst)),
+                         generation=0, graph_world=world, dp=False)
+            self._chans.append(ch)
+            return ch
+
+        template = serve.kv_template(model.init_slot_cache(1, max_len))
+        decode_rank = n_prefill
+        self.workers = []
+        self._stops = []
+        self._threads = []
+        for r in range(n_prefill):
+            w = serve.PrefillWorker(
+                model, params, serve.KVTransfer(self.dps[r], template),
+                claim_ch=chan(serve.PREFILL_QUEUE, r),
+                env_chans={0: chan(serve.kv_channel(0), r)},
+                rank=r, max_len=max_len, prefix=prefix)
+            st = threading.Event()
+            self.workers.append(w)
+            self._stops.append(st)
+            self._threads.append(threading.Thread(
+                target=w.run, args=(st,), daemon=True,
+                name=f"bench-prefill-{r}"))
+        self.engine = serve.DisaggSlotEngine(
+            model, params, serve.KVTransfer(self.dps[decode_rank],
+                                            template),
+            dispatch_ch=chan(serve.PREFILL_QUEUE, decode_rank),
+            arrive_ch=chan(serve.kv_channel(0), decode_rank),
+            num_slots=slots, max_len=max_len, rank=decode_rank,
+            role_rank=0)
+        self.sched = serve.DisaggScheduler(self.engine,
+                                           batch_window=batch_window,
+                                           step_hook=step_hook)
+        for t in self._threads:
+            t.start()
+
+    def close(self) -> None:
+        self.sched.close()
+        self.engine.close()
+        for st in self._stops:
+            st.set()
+        for t in self._threads:
+            t.join(15.0)
+        for ch in self._chans:
+            try:
+                ch.close()
+            except Exception:
+                pass
+        for dp in self.dps:
+            dp.close()
+        self.store.close()
+
+
+def _bursty_workload(max_len: int, seed: int = 7):
+    """The disagg acceptance shape: a steady background of long
+    generations (the latency-bound decodes) + one burst of LONG prompts
+    wanting short generations.  The burst prompts sit in the top prompt
+    bucket, where one prefill costs several decode iterations — the
+    prefill wall a unified pool pays ON its decode loop, admission by
+    admission, while a disagg pool's prefill rank eats it during the
+    decode rank's device step."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    bg = [(rng.integers(1, 251, size=8).astype(np.int32), 56)
+          for _ in range(6)]
+    plens = [384, 448, 512]
+    burst = [(rng.integers(1, 251,
+                           size=plens[i % len(plens)]).astype(np.int32), 4)
+             for i in range(16)]
+    return bg, burst
+
+
+def _drive_burst(sched, engine, bg, burst):
+    """Submit the background, wait for the pool to fill, fire the burst,
+    wait everything; metrics come from the engine's own histograms so
+    both arms are measured identically."""
+    engine.reset_stats()
+    t0 = time.perf_counter()
+    hs = [sched.submit(p, max_new_tokens=g, timeout=60.0)
+          for p, g in bg]
+    fill_deadline = time.monotonic() + 60
+    want_free = max(0, engine.num_slots - len(bg))
+    while engine.free_slots() > want_free \
+            and time.monotonic() < fill_deadline:
+        time.sleep(0.005)
+    hs += [sched.submit(p, max_new_tokens=g, timeout=60.0)
+           for p, g in burst]
+    outs = [h.wait_done(timeout=600.0) for h in hs]
+    wall = time.perf_counter() - t0
+    st = engine.stats()
+    return {"wall_sec": round(wall, 3),
+            "generated_tokens": st["generated_tokens"],
+            "tokens_per_sec": round(st["generated_tokens"] / wall, 1),
+            "p50_ttft_ms": round(st["ttft"]["p50"] * 1e3, 1),
+            "p99_ttft_ms": round(st["ttft"]["p99"] * 1e3, 1),
+            "p99_latency_ms": round(st["e2e"]["p99"] * 1e3, 1),
+            "occupancy": st["occupancy"], "outputs": outs, "stats": st}
+
+
+def _pace_hook(ms: float):
+    """Decode-iteration floor: emulates an accelerator-bound decode on a
+    host CPU (the bench_serve --sharded / CRC-overhead pacing
+    discipline) — the regime disaggregation targets, where prefill
+    compute is the scarce resource a unified pool spends BETWEEN decode
+    iterations while in-flight requests wait."""
+    if ms <= 0:
+        return None
+    return lambda step: time.sleep(ms / 1e3)
+
+
+def _warm_disagg(sched, max_len: int, plens=(8, 48, 64, 96)):
+    """Compile every program both sides hit: one prefill per prompt
+    bucket + the inject scatter per bucket + the pool decode step."""
+    import numpy as np
+
+    rng = np.random.default_rng(99)
+    hs = [sched.submit(rng.integers(1, 251, size=p).astype(np.int32),
+                       max_new_tokens=2, timeout=60.0)
+          for p in plens if p + 3 <= max_len]
+    for h in hs:
+        h.wait_done(timeout=600.0)
+
+
+def run_disagg(smoke: bool = False, write_json: bool = True,
+               pace_ms: float = 24.0) -> dict:
+    """BENCH_SERVE_DISAGG rows: the bursty-mixed unified-vs-disagg
+    comparison (acceptance: disagg higher tokens/s AND lower p99 TTFT)
+    and the prefix-heavy prefill-compute row (acceptance: >= 2x fewer
+    prefilled tokens).  ``--smoke`` = tier-1 gate: disaggregated greedy
+    tokens — prefix-cache hits included — cross-checked token-for-token
+    against offline ``generate()``; no perf assertion."""
+    import numpy as np
+
+    from tpu_dist.serve import PrefixCache, Scheduler, SlotEngine
+
+    model, params, cfg = _build(tiny=smoke)
+    max_len = cfg["max_seq_len"]
+    slots = 8
+
+    if smoke:
+        # correctness only: a handful of requests, three sharing a
+        # 36-token prefix so the cache path (suffix-only prefill) is on
+        # the parity path
+        rig = _DisaggRig(model, params, max_len, slots=4,
+                         prefix=PrefixCache(block_tokens=16))
+        try:
+            shared = list(range(5, 41))
+            reqs = [(np.asarray(shared + [60 + i], np.int32), 6)
+                    for i in range(3)]
+            reqs += [(np.arange(3, 3 + p, dtype=np.int32), g)
+                     for p, g in ((6, 4), (20, 8))]
+            refs = _offline_refs(model, params, reqs)
+            outs = []
+            for p, g in reqs:   # sequential: deterministic cache hits
+                outs.append(rig.sched.submit(
+                    p, max_new_tokens=g,
+                    timeout=60.0).wait_done(timeout=600.0))
+            for i, ref in enumerate(refs):
+                assert outs[i] == ref, (
+                    f"disagg request {i} diverged from offline "
+                    f"generate(): {outs[i]} vs {ref}")
+            st = rig.engine.stats()
+            assert st["kv"]["transfers"] == len(reqs), st["kv"]
+            assert st["prefix_cache"]["hits"] >= 2, st["prefix_cache"]
+            row = {"metric": "serve_disagg_smoke", "requests": len(reqs),
+                   "transfers": st["kv"]["transfers"],
+                   "prefix_hits": st["prefix_cache"]["hits"],
+                   "tokens_ok": True}
+            print(json.dumps(row))
+            return row
+        finally:
+            rig.close()
+
+    rows = []
+    # the bursty arms want prompts long enough that one prefill costs
+    # several decode iterations — a longer-context build of the same LM
+    import jax
+
+    from tpu_dist.models import TransformerLM
+
+    lcfg = dict(cfg, max_seq_len=640)
+    lmodel = TransformerLM(**lcfg)
+    lparams = lmodel.init(jax.random.key(0))
+    lmax = lcfg["max_seq_len"]
+    bg, burst = _bursty_workload(lmax)
+    warm_plens = (8, 384)   # the two prompt buckets the workload hits
+    hook = _pace_hook(pace_ms)
+
+    # unified arm: ONE slot pool prefills between its own decode
+    # iterations (best-of-3, the anti-noise discipline)
+    uni = None
+    engine = SlotEngine(lmodel, lparams, num_slots=slots, max_len=lmax)
+    sched = Scheduler(engine, step_hook=hook)
+    try:
+        _warm_disagg(sched, lmax, plens=warm_plens)
+        for _ in range(3):
+            r = _drive_burst(sched, engine, bg, burst)
+            if uni is None or r["tokens_per_sec"] > uni["tokens_per_sec"]:
+                uni = r
+    finally:
+        sched.close()
+    uni.pop("outputs"), uni.pop("stats")
+    uni.update(mode="unified", metric="serve_disagg_bursty",
+               slots=slots, pace_ms=pace_ms)
+    rows.append(uni)
+
+    # disagg arm: same workload, same pacing, same pool width — prefill
+    # runs on its own rank while the decode pool sleeps through its
+    # emulated device step
+    dis = None
+    rig = _DisaggRig(lmodel, lparams, lmax, slots, step_hook=hook)
+    try:
+        _warm_disagg(rig.sched, lmax, plens=warm_plens)
+        for _ in range(3):
+            r = _drive_burst(rig.sched, rig.engine, bg, burst)
+            if dis is None or r["tokens_per_sec"] > dis["tokens_per_sec"]:
+                dis = r
+        dis_stats = dis.pop("stats")
+        dis.pop("outputs")
+    finally:
+        rig.close()
+    dis.update(mode="disagg", metric="serve_disagg_bursty",
+               slots=slots, pace_ms=pace_ms,
+               transfer_p99_ms=round(
+                   dis_stats["transfer"]["p99"] * 1e3, 1),
+               kv_transfers=dis_stats["kv"]["transfers"])
+    rows.append(dis)
+    rows.append({
+        "metric": "serve_disagg_bursty_vs_unified",
+        "tokens_per_sec_ratio": round(
+            dis["tokens_per_sec"] / uni["tokens_per_sec"], 3),
+        "p99_ttft_ratio": round(
+            dis["p99_ttft_ms"] / uni["p99_ttft_ms"], 3),
+        "unit": "disagg / unified on the bursty mixed workload "
+                "(acceptance: tokens ratio > 1.0 AND ttft ratio < 1.0)"})
+
+    # prefix-heavy row: one hot 64-token preamble heads every request —
+    # the suffix-only prefill must cut prefill COMPUTE >= 2x (token
+    # ratio is the deterministic proxy; seconds reported alongside)
+    prefix = PrefixCache(block_tokens=16)
+    rig = _DisaggRig(model, params, max_len, slots, prefix=prefix,
+                     step_hook=hook)
+    try:
+        _warm_disagg(rig.sched, max_len)
+        w = rig.workers[0]
+        base_total, base_run = w.total_tokens, w.prefilled_tokens
+        rng = np.random.default_rng(11)
+        preamble = rng.integers(1, 251, size=64).astype(np.int32)
+        preqs = [(np.concatenate([preamble,
+                                  rng.integers(1, 251, size=8)
+                                  .astype(np.int32)]), 8)
+                 for _ in range(24)]
+        rig.engine.reset_stats()
+        t0 = time.perf_counter()
+        hs = [rig.sched.submit(p, max_new_tokens=g, timeout=60.0)
+              for p, g in preqs]
+        for h in hs:
+            h.wait_done(timeout=600.0)
+        wall = time.perf_counter() - t0
+        st = rig.engine.stats()
+        total = w.total_tokens - base_total
+        ran = w.prefilled_tokens - base_run
+        pf = st["prefill"]
+        rows.append({
+            "metric": "serve_disagg_prefix_heavy",
+            "requests": len(preqs), "prefix_tokens": 64,
+            "tokens_requested": int(total), "tokens_prefilled": int(ran),
+            "prefill_compute_ratio": round(total / max(ran, 1), 2),
+            "prefix_hits": st["prefix_cache"]["hits"],
+            "prefix_tokens_saved": st["prefix_cache"]["tokens_saved"],
+            "mean_prefill_ms": round(pf["mean"] * 1e3, 2),
+            "tokens_per_sec": round(st["generated_tokens"] / wall, 1),
+            "unit": "requested/prefilled prefill tokens with one hot "
+                    "64-token preamble (acceptance >= 2.0)"})
+    finally:
+        rig.close()
+
+    for r in rows:
+        print(json.dumps(r))
+    summary = {
+        "metric": "serve_disagg_tokens_per_sec",
+        "value": dis["tokens_per_sec"],
+        "unit": f"aggregate tokens/s, 1 prefill + 1 decode rank, bursty "
+                f"mixed workload, {pace_ms}ms emulated decode step "
+                f"(dim {cfg['dim']} depth {cfg['depth']} LM)",
+        "unified_tokens_per_sec": uni["tokens_per_sec"],
+        "p99_ttft_ms_disagg": dis["p99_ttft_ms"],
+        "p99_ttft_ms_unified": uni["p99_ttft_ms"],
+        "prefix_prefill_compute_ratio": rows[-1][
+            "prefill_compute_ratio"],
+        "n_chips": 1,
+    }
+    if write_json:
+        out = os.path.join(_REPO, "BENCH_SERVE_DISAGG.json")
+        with open(out, "w") as f:
+            json.dump(rows + [summary], f, indent=1)
+        print(f"wrote {out}")
+    return summary
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true",
@@ -732,6 +1062,14 @@ def main() -> int:
                     help="multi-rank rows: replica scaling through the "
                          "gateway registry + tensor-parallel sharded "
                          "decode (BENCH_SERVE_SHARDED.json)")
+    ap.add_argument("--disagg", action="store_true",
+                    help="disaggregated prefill/decode rows: bursty-"
+                         "mixed unified-vs-disagg + prefix-heavy "
+                         "prefill-compute (BENCH_SERVE_DISAGG.json); "
+                         "with --smoke, the token-parity tier-1 gate")
+    ap.add_argument("--pace-ms", type=float, default=24.0,
+                    help="emulated decode-step floor for the disagg "
+                         "rows (see _pace_hook)")
     ap.add_argument("--requests", type=int, default=0)
     ap.add_argument("--slots", type=int, default=0)
     # hidden: one shard rank of the sharded row (own pinned process)
@@ -751,6 +1089,9 @@ def main() -> int:
     args = ap.parse_args()
     if getattr(args, "_shard_worker"):
         return _shard_worker_main(args)
+    if args.disagg:
+        run_disagg(smoke=args.smoke, pace_ms=args.pace_ms)
+        return 0
     if args.sharded:
         run_sharded(smoke=args.smoke)
         return 0

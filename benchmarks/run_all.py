@@ -162,6 +162,7 @@ def main() -> None:
         "gen_long_int8_cache": "transformer_lm_decode_long_context_int8_cache",
         "serve": "serve_continuous_batching_tokens_per_sec",
         "serve_sharded": "serve_sharded_tokens_per_sec",
+        "serve_disagg": "serve_disagg_tokens_per_sec",
         "roles": "roles_channel_dp_best_mb_s",
     }
     import bench  # repo-root headline (MNIST ConvNet) — ratchet a copy here
@@ -186,6 +187,7 @@ def main() -> None:
                       generate.run_long_context_int8_cache),
                      ("serve", bench_serve.run),
                      ("serve_sharded", bench_serve.run_sharded),
+                     ("serve_disagg", bench_serve.run_disagg),
                      ("roles", bench_roles.run)):
         try:
             r = fn()

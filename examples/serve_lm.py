@@ -14,7 +14,7 @@ the gateway's next submit reaches the new incarnation::
     python -m tpu_dist.launch --standalone --max_restarts=3 --serve \\
         examples/serve_lm.py --tiny
 
-Two multi-rank shapes (docs/serving.md#multi-rank):
+Three multi-rank shapes (docs/serving.md#multi-rank):
 
 - ``--backend-name NAME`` — independent **replicas**: run several
   launchers (or workers) against one store, each registering a distinct
@@ -32,6 +32,17 @@ Two multi-rank shapes (docs/serving.md#multi-rank):
 
       python -m tpu_dist.launch --standalone --nproc_per_node=2 \\
           --max_restarts=3 --serve examples/serve_lm.py --tiny --sharded
+
+- ``--disagg`` — **disaggregated prefill/decode** (tpu_dist.serve.disagg):
+  launch with ``--roles prefill:P,decode:D`` so prompt bursts never stall
+  in-flight decodes — prefill ranks claim prompts off the shared typed
+  channel, prefill them (through the shared prefix cache on repeated
+  prefixes) and ship the KV rows to the owning decode rank over the data
+  plane; decode ranks admit arrived requests between iterations and
+  serve the gateway, one registered backend per decode rank::
+
+      python -m tpu_dist.launch --standalone --max_restarts=3 --serve \\
+          --roles prefill:1,decode:1 examples/serve_lm.py --tiny --disagg
 
 Self-healing wiring: the worker publishes heartbeats
 (:class:`tpu_dist.resilience.Heartbeat`) with the scheduler's decode-step
@@ -81,6 +92,27 @@ def build_parser() -> argparse.ArgumentParser:
                         "whole world (tpu_dist.serve.sharded): rank 0 "
                         "leads + serves, other ranks follow; needs the "
                         "control-plane store + num_heads %% world == 0")
+    p.add_argument("--disagg", action="store_true",
+                   help="disaggregated prefill/decode: run under python -m "
+                        "tpu_dist.launch --serve --roles prefill:P,decode:D "
+                        "— prefill ranks claim prompts off the shared "
+                        "queue and ship KV rows over the data plane, "
+                        "decode ranks own requests + serve the gateway "
+                        "(tpu_dist.serve.disagg, docs/serving.md)")
+    p.add_argument("--kv-wire", default=None,
+                   help="disagg KV-transfer wire compression opt-in "
+                        "(e.g. int8_block256) — lossy, so greedy parity "
+                        "with generate() no longer holds; default = exact")
+    p.add_argument("--prefix-block", type=int, default=16,
+                   help="prefix-cache chain granularity in tokens")
+    p.add_argument("--prefix-cache-mb", type=int, default=64,
+                   help="prefix-cache resident byte cap, MiB (0 disables "
+                        "the cache entirely)")
+    p.add_argument("--prefix-spill", default=None,
+                   help="page cold prefix entries to this directory "
+                        "instead of evicting (restored bitwise-equal via "
+                        "the reshard fragment reader; the index persists "
+                        "across restarts)")
     p.add_argument("--backend-name", default="default",
                    help="this backend's name in the gateway's registry "
                         "(replicas register distinct names; a restarted "
@@ -257,6 +289,147 @@ def _run_sharded(args, model, params, store, rank: int, world: int,
     return rc
 
 
+def _run_disagg(args, model, params, cache_dtype) -> int:
+    """Disaggregated worker body: every rank of ``--roles
+    prefill:P,decode:D`` runs this.  Prefill ranks claim descriptors off
+    the shared ``prefill-q`` channel, prefill (through the shared
+    :class:`~tpu_dist.serve.PrefixCache` when it hits) and ship KV rows +
+    first token to the owning decode rank; decode ranks run the
+    :class:`~tpu_dist.serve.DisaggSlotEngine` pool and serve the gateway
+    — each decode rank registers its own backend name, so the gateway
+    load-balances across the decode group."""
+    import threading
+
+    import jax  # noqa: F401  (device runtime up before the data plane)
+
+    from tpu_dist import resilience, serve
+    from tpu_dist.collectives.transport import DataPlane
+    from tpu_dist.roles.graph import parse_roles_spec
+    from tpu_dist.roles.runtime import init_role_graph
+
+    if args.cache_dtype == "int8":
+        print("[serve_lm] --disagg does not support --cache-dtype int8 "
+              "(transferred rows carry no scales); use --kv-wire "
+              "int8_blockN to compress the WIRE instead",
+              file=sys.stderr, flush=True)
+        return 2
+    spec = os.environ.get("TPU_DIST_ROLES")
+    if not spec or not os.environ.get("TPU_DIST_STORE_ADDR"):
+        print("[serve_lm] --disagg needs the role-graph launcher: "
+              "python -m tpu_dist.launch --standalone --serve "
+              "--roles prefill:P,decode:D examples/serve_lm.py --disagg",
+              file=sys.stderr, flush=True)
+        return 2
+    parsed = parse_roles_spec(spec)
+    if [r.name for r in parsed.roles] != [serve.ROLE_PREFILL,
+                                          serve.ROLE_DECODE]:
+        print(f"[serve_lm] --disagg expects --roles prefill:P,decode:D "
+              f"(prefill first, the canonical disagg_graph order), got "
+              f"{spec!r}", file=sys.stderr, flush=True)
+        return 2
+    n_prefill, n_decode = (r.world for r in parsed.roles)
+    graph = serve.disagg_graph(n_prefill, n_decode)
+    ctx = init_role_graph(graph)          # validates vs the published map
+    rr = ctx.role_rank
+    dp = DataPlane(ctx.store, ctx.rank, ctx.world,
+                   generation=ctx.generation)
+    # both endpoints derive the shape contract from their OWN model, so a
+    # drifted geometry is a named KVTransferError, not a silent reshape
+    template = serve.kv_template(
+        model.init_slot_cache(1, args.max_seq_len, dtype=cache_dtype))
+    kv = serve.KVTransfer(dp, template, wire=args.kv_wire)
+
+    hb = resilience.Heartbeat(rank=ctx.rank)
+    hb.start()
+    stop = None
+    if args.exit_on_preempt:
+        from tpu_dist import checkpoint as ckpt
+        stop = ckpt.GracefulShutdown().__enter__()
+    _write_pid(args, ctx.rank)
+
+    try:
+        if ctx.role == serve.ROLE_PREFILL:
+            prefix = None
+            if args.prefix_cache_mb > 0:
+                prefix = serve.PrefixCache(
+                    block_tokens=args.prefix_block,
+                    capacity_bytes=args.prefix_cache_mb << 20,
+                    spill_dir=args.prefix_spill)
+            worker = serve.PrefillWorker(
+                model, params, kv,
+                claim_ch=ctx.channel(serve.PREFILL_QUEUE, dp=False),
+                env_chans={d: ctx.channel(serve.kv_channel(d), dp=False)
+                           for d in range(n_decode)},
+                rank=ctx.rank, max_len=args.max_seq_len,
+                dtype=cache_dtype, prefix=prefix)
+            print(f"[serve_lm] prefill[{rr}] up (rank {ctx.rank}, "
+                  f"prefix cache "
+                  f"{'off' if prefix is None else f'{args.prefix_cache_mb}MiB'})",
+                  flush=True)
+            wstop = threading.Event()
+            t = threading.Thread(target=worker.run, args=(wstop,),
+                                 daemon=True,
+                                 name="tpu_dist-prefill-worker")
+            t.start()
+            deadline = (time.monotonic() + args.run_seconds
+                        if args.run_seconds > 0 else None)
+            while deadline is None or time.monotonic() < deadline:
+                if stop is not None and stop.requested:
+                    # finish the in-flight claim, then the preemption
+                    # exit code — unclaimed descriptors stay on the
+                    # queue for the surviving prefill ranks
+                    wstop.set()
+                    t.join(30.0)
+                    if prefix is not None:
+                        prefix.close()
+                    hb.stop()
+                    os._exit(resilience.PREEMPTED_EXIT_CODE)
+                if not t.is_alive():
+                    break               # decode side closed the queue
+                hb.set_step(worker.claims)
+                time.sleep(0.25)
+            wstop.set()
+            t.join(10.0)
+            if prefix is not None:
+                prefix.close()
+            print(f"[serve_lm] prefill[{rr}] done: {worker.stats()}",
+                  flush=True)
+            return 0
+
+        # decode rank: owns requests end to end, serves the gateway
+        backend = (args.backend_name if rr == 0
+                   else f"{args.backend_name}-d{rr}")
+        engine = serve.DisaggSlotEngine(
+            model, params, kv,
+            dispatch_ch=ctx.channel(serve.PREFILL_QUEUE, dp=False),
+            arrive_ch=ctx.channel(serve.kv_channel(rr), dp=False),
+            num_slots=args.slots, max_len=args.max_seq_len,
+            cache_dtype=cache_dtype, rank=ctx.rank, role_rank=rr)
+        sched = serve.DisaggScheduler(engine,
+                                      batch_window=args.batch_window,
+                                      step_hook=_step_hook(args, hb))
+        frontend = serve.Frontend(sched, port=args.port, store=ctx.store,
+                                  backend_name=backend)
+        print(f"[serve_lm] decode[{rr}] serving on {frontend.addr} as "
+              f"{backend!r} ({args.slots} slots, prefill pool "
+              f"{n_prefill})", flush=True)
+        try:
+            rc = _serve_loop(args, sched, frontend, hb, stop, resilience,
+                             engine=engine)
+        finally:
+            frontend.close()
+            sched.close()
+            engine.close()
+        return rc
+    finally:
+        hb.stop()
+        try:
+            dp.close()
+        except Exception:
+            pass
+        ctx.close()
+
+
 def main() -> int:
     args = build_parser().parse_args()
     os.environ.setdefault("JAX_PLATFORMS", args.backend)
@@ -284,6 +457,11 @@ def main() -> int:
     params = model.init(jax.random.key(0))
     cache_dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
                    "int8": jnp.int8}[args.cache_dtype]
+
+    if args.disagg:
+        # init_role_graph installs the chaos/obs hooks and connects the
+        # store itself (role workers never call rendezvous)
+        return _run_disagg(args, model, params, cache_dtype)
 
     if args.sharded:
         # shard groups never join jax.distributed: collectives ride the

@@ -257,6 +257,40 @@ def follow(follower):
     return plan, other
 """
 
+# disagg KV transfer (ISSUE 17): a kv/xfer receiver's fetch without a
+# deadline hangs forever on a dead prefill rank (TD004 family); the verb
+# is too common to flag on arbitrary receivers
+TD004_KV_POS = """
+def land(kv, src, rid):
+    arrival = kv.fetch(src, rid)
+    return arrival
+"""
+
+TD004_KV_NEG = """
+def land(kv, xfer, catalog, src, rid):
+    a = kv.fetch(src, rid, 30.0)
+    b = xfer.fetch(src, rid, timeout=30.0)
+    row = catalog.fetch(rid)        # non-kv receiver: ordinary vocabulary
+    return a, b, row
+"""
+
+# disagg KV transfer async forms: send is a plain _ASYNC_ISSUERS member,
+# fetch is receiver-gated — both return Work-like handles whose captured
+# KVTransferError surfaces only at wait()
+TD007_KV_POS = """
+def ship(kv, xfer, dst, src, rid, rows):
+    kv.send(dst, rid, rows, 8, 0, async_op=True)
+    xfer.fetch(src, rid, 30.0, async_op=True)
+"""
+
+TD007_KV_NEG = """
+def ship(kv, catalog, dst, src, rid, rows):
+    n = kv.send(dst, rid, rows, 8, 0)            # sync: returns bytes
+    w = kv.fetch(src, rid, 30.0, async_op=True)
+    catalog.fetch(rid, async_op=True)            # non-kv receiver
+    return n, w.wait(30.0)
+"""
+
 # serving service-discovery keys are documented cross-generation infra
 TD003_SERVE_NEG = """
 def publish(store, addr):
@@ -605,6 +639,22 @@ class TestRules:
         assert _rules(found) == ["TD004"]
         assert "recv_plan" in found[0].message
         assert _rules(lint_source(TD004_SHARD_NEG, "t.py")) == []
+
+    def test_td004_kv_fetch_needs_deadline(self):
+        # ISSUE 17: KVTransfer.fetch blocks on a dead prefill rank —
+        # deadline required; gating keeps non-kv .fetch() vocabulary clean
+        found = lint_source(TD004_KV_POS, "t.py")
+        assert _rules(found) == ["TD004"]
+        assert "fetch" in found[0].message
+        assert _rules(lint_source(TD004_KV_NEG, "t.py")) == []
+
+    def test_td007_kv_async_send_fetch_flag_drops(self):
+        # ISSUE 17: async KV send/fetch return Work-like handles whose
+        # captured KVTransferError is lost with a dropped handle
+        found = lint_source(TD007_KV_POS, "t.py")
+        assert _rules(found) == ["TD007", "TD007"]
+        assert all(f.severity == "error" for f in found)
+        assert _rules(lint_source(TD007_KV_NEG, "t.py")) == []
 
     def test_td003_serve_discovery_keys_allowlisted(self):
         # tpu_dist/serve/{backend,gateway} are cross-generation service

@@ -110,6 +110,10 @@ _WAIT_METHODS: Dict[str, int] = {
     "recv_plan": 0,        # serve ShardFollower.recv_plan(timeout): a
                            # dead shard leader must surface as a named
                            # PeerGoneError/TimeoutError, never a hang
+    "fetch": 2,            # disagg KVTransfer.fetch(src, rid, timeout):
+                           # receiver-gated on kv/xfer names in the rule
+                           # body — `fetch` is too common a verb to flag
+                           # on arbitrary receivers
 }
 _TIMEOUT_KWARGS = frozenset({"timeout", "deadline", "timeout_s"})
 
@@ -422,6 +426,11 @@ def rule_td004(tree: ast.AST, path: str) -> List[Finding]:
         if _has_timeout(node, name):
             continue
         recv = _dotted(node.func.value) or "<expr>"
+        if name == "fetch" and "kv" not in recv.lower() \
+                and "xfer" not in recv.lower():
+            # only the disagg KV-transfer fetch blocks on a dead peer;
+            # any other receiver's fetch is ordinary vocabulary
+            continue
         if name == "wait" and len(node.args) == 1 \
                 and "store" not in recv.lower():
             # cv.wait(t) / event.wait(t): the single positional IS the
@@ -626,6 +635,16 @@ def _is_async_call(node: ast.AST) -> bool:
     if not isinstance(node.func, ast.Attribute):
         return False
     recv_name = (_dotted(node.func.value) or "").lower()
+    # disagg KV transfer: <kv/xfer>.fetch(src, rid, timeout,
+    # async_op=True) returns a Work-like handle — the captured
+    # KVTransferError (dead prefill rank, geometry drift) surfaces only
+    # at wait(), so dropping it loses the failure with the result.
+    # (kv.send's async form is already covered by _ASYNC_ISSUERS.)
+    if name == "fetch" and ("kv" in recv_name or "xfer" in recv_name):
+        for kw in node.keywords:
+            if kw.arg == "async_op" and isinstance(kw.value, ast.Constant):
+                return bool(kw.value.value)
+        return False
     if name in ("all_reduce", "reduce_scatter") \
             and ("bucketer" in recv_name or "zopt" in recv_name
                  or "zero" in recv_name):

@@ -20,7 +20,30 @@ import jax.numpy as jnp
 from .. import nn
 from ..nn.module import current_context, run_capturing_state
 
-__all__ = ["TransformerLM", "TransformerBlock"]
+__all__ = ["TransformerLM", "TransformerBlock", "write_slot_rows"]
+
+
+def write_slot_rows(cache, rows, slot):
+    """Scatter ONE request's per-layer batch-1 cache rows into slot
+    ``slot`` of a slot-cache pool, leaving every other slot untouched —
+    the write half of :meth:`TransformerLM.prefill_into_slot`, factored
+    out so the disaggregated-serving path (tpu_dist/serve/disagg.py) can
+    land *transferred* KV rows in a decode rank's pool through the exact
+    same scatter the unified engine uses (the two paths cannot drift).
+
+    ``rows`` carries one ``{"k": (1, Tmax, ...), ...}`` entry per layer
+    path; only keys present in the pool entry are written (a row's extra
+    ``index`` is ignored)."""
+    slot = jnp.asarray(slot, jnp.int32)
+    out = {}
+    for path, pool in cache.items():
+        row = rows[path]
+        out[path] = {
+            k: jax.lax.dynamic_update_slice(
+                pool[k], row[k].astype(pool[k].dtype),
+                (slot,) + (0,) * (pool[k].ndim - 1))
+            for k in pool}
+    return out
 
 
 def _norm_cls(norm: str):
@@ -257,18 +280,48 @@ class TransformerLM(nn.Module):
         pre = self.init_cache(1, max_len, dtype)
         logits, st = self.apply(params, jnp.asarray(prompt)[None, :],
                                 state=pre)
-        slot = jnp.asarray(slot, jnp.int32)
-        new_cache = {}
-        for path, pool in cache.items():
-            row = st[path]
-            new_cache[path] = {
-                k: jax.lax.dynamic_update_slice(
-                    pool[k], row[k].astype(pool[k].dtype),
-                    (slot,) + (0,) * (pool[k].ndim - 1))
-                for k in pool}
+        new_cache = write_slot_rows(cache, st, slot)
         return jax.lax.dynamic_index_in_dim(
             logits[0], jnp.asarray(length, jnp.int32) - 1, axis=0,
             keepdims=False), new_cache
+
+    def prefill_rows(self, params, prompt, length, max_len,
+                     dtype=jnp.float32, prefix_rows=None, prefix_len=0):
+        """Prefill ONE request into fresh batch-1 cache rows with NO slot
+        pool in sight — the disaggregated-prefill primitive: a prefill
+        rank computes these rows and ships them to a decode rank, where
+        :func:`write_slot_rows` lands them in a free slot.
+
+        ``prompt``: (S,) int suffix tokens, padded past the true suffix
+        length with any valid id (padding K/V lands at positions
+        ``>= length`` and is masked/overwritten exactly as in
+        :meth:`prefill_into_slot`).  ``length``: TOTAL true token count
+        including any cached prefix.  With ``prefix_rows`` (batch-1 rows
+        holding the first ``prefix_len`` tokens' K/V — a prefix-cache
+        hit), only the suffix runs the forward: positions start at
+        ``prefix_len`` (learned table via ``pos_offset``, rope via the
+        cache write index) and the suffix K/V appends at
+        ``[prefix_len, prefix_len + S)``.  Returns ``(last-real-token
+        logits (vocab,), rows)`` where ``rows`` are full-width
+        ``(1, max_len)`` per-layer entries (no ``index``).  With no
+        prefix this is bitwise-identical to the forward inside
+        :meth:`prefill_into_slot` (same apply, same padding discipline);
+        one padded suffix length = one compiled program."""
+        length = jnp.asarray(length, jnp.int32)
+        plen = jnp.asarray(prefix_len, jnp.int32)
+        if prefix_rows is None:
+            pre = self.init_cache(1, max_len, dtype)
+            logits, st = self.apply(params, jnp.asarray(prompt)[None, :],
+                                    state=pre)
+        else:
+            pre = {path: dict(entry, index=plen)
+                   for path, entry in prefix_rows.items()}
+            logits, st = self.apply(params, jnp.asarray(prompt)[None, :],
+                                    pos_offset=plen, state=pre)
+        rows = {path: {k: v for k, v in st[path].items() if k != "index"}
+                for path in st}
+        return jax.lax.dynamic_index_in_dim(
+            logits[0], length - plen - 1, axis=0, keepdims=False), rows
 
     def generate(self, params, prompt, max_new_tokens: int,
                  temperature: float = 0.0, rng=None, cache_dtype=None,

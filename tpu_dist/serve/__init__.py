@@ -16,6 +16,11 @@ The throughput half of the serving story over the existing stack:
   the model ranks and keeps traffic flowing across supervised restarts.
 - :class:`ServeClient` (client.py): streaming handles whose terminal
   state is always reached — tokens + done, or a NAMED error.
+- disaggregated prefill/decode (disagg.py / kvtransfer.py / prefix.py):
+  prefill and decode as separate role groups — prefill ranks ship each
+  request's KV rows to its decode rank over CRC-sealed data-plane
+  fragments, repeated prompt prefixes served from a content-verified
+  :class:`PrefixCache` with only the suffix prefilled.
 
 See docs/serving.md for the slot lifecycle, scheduler policy, knobs and
 measured numbers; ``benchmarks/bench_serve.py`` for the QPS/latency
@@ -31,6 +36,11 @@ from .frontend import (BACKEND_KEY, BACKENDS_REG_PREFIX, BACKENDS_SEQ_KEY,
                        GATEWAY_KEY, ROLE_FRONTEND, ROLE_MODEL_SHARD,
                        Frontend, Gateway, list_backends, register_backend,
                        store_from_env)
+from .disagg import (PREFILL_QUEUE, ROLE_DECODE, ROLE_PREFILL, DisaggError,
+                     DisaggScheduler, DisaggSlotEngine, PrefillWorker,
+                     disagg_graph, kv_channel)
+from .kvtransfer import KVTransfer, KVTransferError, kv_template
+from .prefix import PrefixCache
 from .scheduler import Scheduler
 from .sharded import (ShardConfigError, ShardedDecoder, ShardedLM,
                       ShardedParams, ShardedSlotEngine, ShardFollower,
@@ -47,4 +57,8 @@ __all__ = ["SlotEngine", "Scheduler", "Frontend", "Gateway", "ServeClient",
            "register_backend", "list_backends",
            "ShardedLM", "ShardedDecoder", "ShardedSlotEngine",
            "ShardFollower", "ShardedParams", "shard_params",
-           "ShardConfigError", "ShardPlanError"]
+           "ShardConfigError", "ShardPlanError",
+           "ROLE_PREFILL", "ROLE_DECODE", "PREFILL_QUEUE", "kv_channel",
+           "disagg_graph", "DisaggError", "DisaggSlotEngine",
+           "DisaggScheduler", "PrefillWorker",
+           "KVTransfer", "KVTransferError", "kv_template", "PrefixCache"]

@@ -338,6 +338,21 @@ class Scheduler:
         finally:
             self._staged.task_done()
 
+    def _sweep_once(self) -> bool:
+        """One expiry sweep; False = fatal engine death (stop set).  The
+        sweep can fail for real on a multi-rank engine — the sharded
+        leader broadcasts its free plan AND its idle-liveness probe here,
+        so a dead follower's ``PeerGoneError`` surfaces at the iteration
+        boundary; it must take the same cause-naming shutdown as a fatal
+        ``step()``, not kill the loop thread silently."""
+        try:
+            self.engine.sweep_expired()
+        except Exception as e:
+            self._fatal = e
+            self._stop.set()
+            return False
+        return True
+
     def _step_once(self) -> bool:
         """One decode iteration; False = fatal engine death (stop set)."""
         try:
@@ -375,8 +390,10 @@ class Scheduler:
                     self._staged.task_done()
                 held, window_start = [], None
                 self._reject_queued()
-                self.engine.sweep_expired()  # cancelled slots free even
-                # while draining — the drain must not wait on them
+                # cancelled slots free even while draining — the drain
+                # must not wait on them
+                if not self._sweep_once():
+                    break
                 if not self.engine.idle():
                     if not self._step_once():
                         break
@@ -389,7 +406,8 @@ class Scheduler:
             # free HERE, before admission sees the free-slot count — a
             # disconnected client's request stops costing decode steps
             # after at most one iteration
-            self.engine.sweep_expired()
+            if not self._sweep_once():
+                break
             # -- pull staged arrivals (never beyond the free slots) ----------
             while len(held) < self.engine.free_slots():
                 try:

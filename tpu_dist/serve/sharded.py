@@ -54,8 +54,10 @@ uncompressed ``arrays.npz`` — the same zip-local-header fragment math
 from __future__ import annotations
 
 import json
+import os
 import re
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -68,6 +70,15 @@ __all__ = ["ShardedLM", "ShardedDecoder", "ShardedSlotEngine",
 
 _CTL_TAG = "sctl"        # leader -> follower control-plan frames
 _PLAN_TIMEOUT = 300.0    # follower's per-plan recv budget (seconds)
+
+
+def _probe_interval() -> float:
+    """Idle-leader liveness cadence (seconds): with no plan sent for this
+    long, the leader broadcasts a ``ping`` plan so a dead follower is
+    named by ``PeerGoneError`` NOW instead of by the first request that
+    has to fail to discover it.  ``TPU_DIST_SERVE_PROBE`` tunes it;
+    ``0`` disables the probe."""
+    return float(os.environ.get("TPU_DIST_SERVE_PROBE", "") or 2.0)
 
 # below this, the partial-sum combine takes a latency-optimal direct
 # exchange (every rank sends its FULL partial to every peer, folds in
@@ -659,6 +670,7 @@ class ShardedSlotEngine(SlotEngine):
         self._closed_plan_sent = False
         self._poisoned: Optional[BaseException] = None
         self._bcast_mu = threading.Lock()
+        self._last_plan = time.monotonic()
         super().__init__(decoder.slm, decoder.params, num_slots=num_slots,
                          max_len=max_len, cache_dtype=cache_dtype,
                          min_bucket=min_bucket)
@@ -694,6 +706,7 @@ class ShardedSlotEngine(SlotEngine):
             except Exception:
                 if not best_effort:
                     raise
+        self._last_plan = time.monotonic()
 
     def _pre_admit(self, req: Request, slot: int) -> None:
         self._check_lockstep()
@@ -746,6 +759,29 @@ class ShardedSlotEngine(SlotEngine):
 
     def _pre_free(self, slots: List[int]) -> None:
         self._bcast({"op": "free", "slots": [int(s) for s in slots]})
+
+    def sweep_expired(self) -> int:
+        """Parent sweep + the idle-liveness probe (PR 13's documented
+        limit): the scheduler loop calls this every iteration boundary,
+        so an IDLE leader still touches every follower socket on a
+        bounded cadence — a SIGKILLed follower raises the named
+        ``PeerGoneError`` here (the scheduler records it as fatal and the
+        gang restarts) instead of wedging the first post-idle request."""
+        freed = super().sweep_expired()
+        self._probe_followers()
+        return freed
+
+    def _probe_followers(self) -> None:
+        if self.decoder.world <= 1 or self._poisoned is not None \
+                or self._closed_plan_sent:
+            return
+        itv = _probe_interval()
+        if itv <= 0 or time.monotonic() - self._last_plan < itv:
+            return
+        # a follower answers a ping by merely staying connected: the
+        # probe's value is the SEND walking every follower's socket,
+        # where a dead peer's down marker raises by name
+        self._bcast({"op": "ping"})
 
     def fail_all(self, exc: BaseException) -> None:
         # scheduler close / fatal: tell followers the group is done —
@@ -899,6 +935,8 @@ class ShardFollower:
                 self._check_slot(slot)
                 if self.shadow[int(slot)] is not None:
                     self._free(int(slot))
+        elif op == "ping":
+            pass    # idle-liveness probe: staying connected IS the answer
         elif op == "close":
             self.close_cause = plan.get("cause", "shutdown")
             return False
