@@ -37,4 +37,5 @@ def test_suppressions_stay_bounded():
     findings = lint_paths([os.path.join(_REPO, "tpu_dist"),
                            os.path.join(_REPO, "examples")])
     suppressed = [f for f in findings if f.suppressed]
-    assert len(suppressed) <= 12, "\n".join(f.render() for f in suppressed)
+    # dropped from 12 after the reap_process/bounded-wait burndown (PR 18)
+    assert len(suppressed) <= 10, "\n".join(f.render() for f in suppressed)

@@ -366,7 +366,11 @@ class TestCLI:
         assert r.returncode == 3
         assert "rank 1" in r.stdout and "#4" in r.stdout
         rj = _cli("diagnose", "--dir", str(tmp_path), "--json")
-        d = json.loads(rj.stdout)
+        doc = json.loads(rj.stdout)
+        # versioned envelope shared with `analysis replay --format json`
+        assert doc["version"] == 1 and doc["tool"] == "diagnose"
+        assert doc["ranks"] == [0, 1]
+        d = doc["diagnosis"]
         assert d["straggler"] == 1 and d["stuck_coll"] == 4
 
     def test_no_dumps_exit_1(self, tmp_path):
@@ -457,10 +461,27 @@ def test_world2_stalled_rank_yields_named_diagnosis(tmp_path):
     assert p.returncode == 3
     assert "rank 1" in p.stdout and "#4" in p.stdout
 
+    # (d) the offline replay sanitizer re-derives the SAME verdict from
+    # the dump files alone: a TD115 error naming the straggler rank and
+    # the collective seq, with the live diagnosis embedded verbatim
+    from tpu_dist.analysis import replay_dir
+    rep = replay_dir(str(obs_dir))
+    td115 = [f for f in rep.findings if f.rule == "TD115"]
+    assert td115 and td115[0].severity == "error", rep.findings
+    assert "rank 1" in td115[0].message and "#4" in td115[0].message
+    assert rep.diagnosis["straggler"] == diag["straggler"]
+    assert rep.diagnosis["straggler_last_coll"] == \
+        diag["straggler_last_coll"]
+    assert rep.diagnosis["stuck_coll"] == diag["stuck_coll"]
 
-# -- armed-overhead bench smoke (tier-1 wiring of bench_obs_overhead) ---------
+
+# -- armed-overhead bench smoke (slow-tier wiring of bench_obs_overhead) ------
 
 
+# slow: ~2 min of best-of-N timing on a box where the <5% overhead gate
+# is dominated by scheduler noise (it fails under any concurrent load —
+# see the ABBA-estimator note in test_ring_collectives); run it alone.
+@pytest.mark.slow
 @pytest.mark.multiprocess
 def test_bench_obs_overhead_smoke():
     """Armed-recorder overhead on the host-collective smoke bench stays

@@ -1,0 +1,344 @@
+"""Static whole-graph protocol verifier (tpu_dist.analysis.protocol).
+
+Unit matrix for the TD100 rule family — deadlock cycles with witness
+schedules (TD101), claim-safety under solo restarts (TD102),
+restart-policy soundness (TD103), dp-path feasibility (TD104), spec
+mismatches (TD105) — plus the graph sources (``--roles``/``--channels``
+grammar, ChannelSpec AST extraction, builder import) and the CI fixtures
+ISSUE 18 ships: every role-graph example must verify CLEAN through
+``python -m tpu_dist.analysis graph``, the deliberately-deadlocking
+fixture must be rejected with its witness printed, and the launcher's
+``--verify_graph`` pre-flight must refuse to spawn it.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tpu_dist.analysis import (GRAPH_RULE_DOCS, extract_channel_specs,
+                               parse_channels_spec, verify_graph)
+from tpu_dist.analysis.protocol import (build_graph, load_graph_builder,
+                                        render_witness)
+from tpu_dist.roles.graph import (ChannelSpec, Role, RoleGraph,
+                                  RoleGraphError)
+
+pytestmark = [pytest.mark.analysis]
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rules(findings):
+    return sorted(f.rule for f in findings)
+
+
+def _graph(roles, channels=()):
+    return RoleGraph(list(roles), list(channels))
+
+
+# -- TD101: bounded-channel deadlock cycles -----------------------------------
+
+
+class TestDeadlockCycles:
+    def test_two_role_queue_cycle_is_deadlock_with_witness(self):
+        g = _graph([Role("a", 1), Role("b", 1)],
+                   [ChannelSpec("fwd", src="a", dst="b", depth=2),
+                    ChannelSpec("bwd", src="b", dst="a", depth=3)])
+        fs = verify_graph(g)
+        td101 = [f for f in fs if f.rule == "TD101"]
+        assert len(td101) == 1 and td101[0].severity == "error"
+        msg = td101[0].message
+        # the witness schedule is embedded in the finding, step by step
+        assert "witness schedule" in msg
+        assert "'fwd'" in msg and "'bwd'" in msg
+        assert "wait-for cycle" in msg
+        assert "a -> b -> a" in msg or "b -> a -> b" in msg
+
+    def test_self_loop_counts(self):
+        g = _graph([Role("a", 2)],
+                   [ChannelSpec("loop", src="a", dst="a", depth=4)])
+        assert "TD101" in _rules(verify_graph(g))
+
+    def test_latest_register_breaks_the_cycle(self):
+        # writes to a latest register never block: no wait-for edge
+        g = _graph([Role("a", 1), Role("b", 1)],
+                   [ChannelSpec("fwd", src="a", dst="b", depth=2),
+                    ChannelSpec("bwd", src="b", dst="a", kind="latest")])
+        assert "TD101" not in _rules(verify_graph(g))
+
+    def test_dedicated_drain_breaks_the_cycle(self):
+        # a dedicated-drain consumer (disagg decode's _recv_loop) acks
+        # from its own thread even while the role blocks in put
+        g = _graph([Role("a", 1), Role("b", 1)],
+                   [ChannelSpec("fwd", src="a", dst="b", depth=2),
+                    ChannelSpec("bwd", src="b", dst="a", depth=2,
+                                drain="dedicated")])
+        assert "TD101" not in _rules(verify_graph(g))
+
+    def test_acyclic_chain_is_clean(self):
+        g = _graph([Role("a", 1), Role("b", 1), Role("c", 1)],
+                   [ChannelSpec("ab", src="a", dst="b", depth=2),
+                    ChannelSpec("bc", src="b", dst="c", depth=2)])
+        assert verify_graph(g) == []
+
+    def test_two_disjoint_cycles_two_findings(self):
+        g = _graph([Role(n, 1) for n in ("a", "b", "c", "d")],
+                   [ChannelSpec("ab", src="a", dst="b", depth=1),
+                    ChannelSpec("ba", src="b", dst="a", depth=1),
+                    ChannelSpec("cd", src="c", dst="d", depth=1),
+                    ChannelSpec("dc", src="d", dst="c", depth=1)])
+        assert _rules(verify_graph(g)) == ["TD101", "TD101"]
+
+    def test_witness_renders_every_role_and_depth(self):
+        ch1 = ChannelSpec("x", src="p", dst="q", depth=5)
+        ch2 = ChannelSpec("y", src="q", dst="p", depth=1)
+        text = render_witness([("p", ch1), ("q", ch2)])
+        assert "p puts 5 message(s)" in text
+        assert "q blocks in put #2" in text
+        assert "p -> q -> p" in text
+
+
+# -- TD102: claim-safety under solo restarts ----------------------------------
+
+
+class TestClaimSafety:
+    def test_tight_window_multi_consumer_solo_dst_warns(self):
+        g = _graph([Role("src", 1), Role("pool", 4, restart="solo")],
+                   [ChannelSpec("work", src="src", dst="pool", depth=4)])
+        td102 = [f for f in verify_graph(g) if f.rule == "TD102"]
+        assert len(td102) == 1 and td102[0].severity == "warning"
+        assert "orphaned claims" in td102[0].message
+
+    def test_depth_above_consumer_world_is_silent(self):
+        g = _graph([Role("src", 1), Role("pool", 4, restart="solo")],
+                   [ChannelSpec("work", src="src", dst="pool", depth=8)])
+        assert "TD102" not in _rules(verify_graph(g))
+
+    def test_gang_consumers_are_silent(self):
+        # a gang restart re-fences the generation: claims die with it
+        g = _graph([Role("src", 1), Role("pool", 4)],
+                   [ChannelSpec("work", src="src", dst="pool", depth=2)])
+        assert "TD102" not in _rules(verify_graph(g))
+
+    def test_single_solo_consumer_is_silent(self):
+        # single consumer rewinds its own orphans at attach (healed)
+        g = _graph([Role("src", 1), Role("sink", 1, restart="solo")],
+                   [ChannelSpec("work", src="src", dst="sink", depth=1)])
+        assert "TD102" not in _rules(verify_graph(g))
+
+
+# -- TD103: restart-policy soundness ------------------------------------------
+
+
+class TestRestartSoundness:
+    def test_node_pin_beyond_cluster_is_error(self):
+        g = _graph([Role("a", 1), Role("b", 1, node=3)])
+        td103 = [f for f in verify_graph(g, nnodes=2)
+                 if f.rule == "TD103"]
+        assert td103 and td103[0].severity == "error"
+        assert "@node3" in td103[0].message
+
+    def test_node_pin_without_nnodes_is_silent(self):
+        g = _graph([Role("a", 1), Role("b", 1, node=3)])
+        assert verify_graph(g) == []
+
+    def test_all_solo_graph_warns(self):
+        g = _graph([Role("a", 1, restart="solo"),
+                    Role("b", 2, restart="solo")])
+        td103 = [f for f in verify_graph(g) if f.rule == "TD103"]
+        assert td103 and "no gang anchor" in td103[0].message
+
+    def test_solo_producer_pool_wider_than_depth_warns(self):
+        g = _graph([Role("actors", 4, restart="solo"), Role("learner", 1)],
+                   [ChannelSpec("batches", src="actors", dst="learner",
+                                depth=2)])
+        td103 = [f for f in verify_graph(g) if f.rule == "TD103"]
+        assert td103 and "solo producers" in td103[0].message
+
+
+# -- TD104: dp-path feasibility -----------------------------------------------
+
+
+class TestDpPath:
+    def test_big_payload_to_multi_rank_consumer_warns(self):
+        g = _graph([Role("a", 1), Role("b", 2)],
+                   [ChannelSpec("big", src="a", dst="b",
+                                payload_bytes=1 << 20)])
+        td104 = [f for f in verify_graph(g) if f.rule == "TD104"]
+        assert td104 and "store funnel" in td104[0].message
+
+    def test_below_threshold_or_single_consumer_is_silent(self):
+        g = _graph([Role("a", 1), Role("b", 2), Role("c", 1)],
+                   [ChannelSpec("small", src="a", dst="b",
+                                payload_bytes=1024),
+                    ChannelSpec("big1", src="a", dst="c",
+                                payload_bytes=1 << 20)])
+        assert "TD104" not in _rules(verify_graph(g))
+
+    def test_threshold_override(self):
+        g = _graph([Role("a", 1), Role("b", 2)],
+                   [ChannelSpec("mid", src="a", dst="b",
+                                payload_bytes=2048)])
+        assert "TD104" in _rules(verify_graph(g, dp_threshold=2048))
+        assert "TD104" not in _rules(verify_graph(g, dp_threshold=4096))
+
+
+# -- graph sources: spec grammar, AST extraction, builder import --------------
+
+
+class TestGraphSources:
+    def test_parse_channels_spec_full_grammar(self):
+        chans = parse_channels_spec(
+            "work:a>b:4,pub:b>a:latest,big:a>b:2:payload=65536")
+        by_name = {c.name: c for c in chans}
+        assert by_name["work"].depth == 4 and by_name["work"].kind == "queue"
+        assert by_name["pub"].kind == "latest"
+        assert by_name["big"].payload_bytes == 65536
+        assert by_name["big"].depth == 2
+
+    def test_parse_channels_spec_rejects_garbage(self):
+        with pytest.raises(RoleGraphError):
+            parse_channels_spec("nocolonhere")
+        with pytest.raises(RoleGraphError):
+            parse_channels_spec("work:a>b:wat")
+
+    def test_extract_channel_specs_literals_and_notes(self, tmp_path):
+        script = tmp_path / "worker.py"
+        script.write_text(textwrap.dedent("""
+            from tpu_dist.roles import ChannelSpec
+            DEPTH = 4
+            A = ChannelSpec("batches", src="actor", dst="learner", depth=8)
+            B = ChannelSpec("weights", "learner", "actor", 1, "latest")
+            C = ChannelSpec("dyn", src="actor", dst="learner", depth=DEPTH)
+        """))
+        specs, notes = extract_channel_specs(str(script))
+        assert {s.name for s in specs} == {"batches", "weights"}
+        assert {s.kind for s in specs} == {"queue", "latest"}
+        # the non-literal depth is named, not silently dropped
+        assert len(notes) == 1 and "non-literal" in notes[0]
+
+    def test_build_graph_dangling_endpoint_is_td105(self):
+        graph, findings, _ = build_graph(
+            roles_spec="a:1,b:1", channels_spec="work:a>ghost:2")
+        assert graph is not None  # the valid remainder still verifies
+        td105 = [f for f in findings if f.rule == "TD105"]
+        assert td105 and td105[0].severity == "error"
+        assert "'ghost'" in td105[0].message
+
+    def test_load_graph_builder_file_target(self):
+        g = load_graph_builder(
+            os.path.join(_REPO, "examples", "actor_learner.py")
+            + ":build_graph", "[4]")
+        assert {r.name for r in g.roles} == {"learner", "actor"}
+
+    def test_load_graph_builder_module_target(self):
+        g = load_graph_builder("tpu_dist.serve.disagg:disagg_graph",
+                               "[2, 2]")
+        assert g.channels
+
+
+# -- shipped example graphs are CI fixtures: all verify CLEAN -----------------
+
+
+class TestShippedGraphsVerifyClean:
+    def test_actor_learner(self):
+        g = load_graph_builder(
+            os.path.join(_REPO, "examples", "actor_learner.py")
+            + ":build_graph", "[4]")
+        assert verify_graph(g) == []
+
+    def test_param_server(self):
+        g = load_graph_builder(
+            os.path.join(_REPO, "examples", "param_server.py")
+            + ":build_graph", "[4]")
+        assert verify_graph(g) == []
+
+    def test_serve_disagg(self):
+        # the kv channels form a real prefill<->decode cycle broken only
+        # by decode's dedicated drain thread — the drain="dedicated"
+        # annotation is what verifies it
+        g = load_graph_builder("tpu_dist.serve.disagg:disagg_graph",
+                               "[2, 2]")
+        assert verify_graph(g) == []
+
+
+# -- CLI: `analysis graph` + the launcher --verify_graph pre-flight -----------
+
+
+_DEADLOCK_SCRIPT = textwrap.dedent("""
+    from tpu_dist.roles import ChannelSpec
+
+    FWD = ChannelSpec("fwd", src="a", dst="b", depth=2)
+    BWD = ChannelSpec("bwd", src="b", dst="a", depth=2)
+""")
+
+
+def _run(*argv, **kw):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run([sys.executable, *argv], cwd=_REPO, env=env,
+                          capture_output=True, text=True, timeout=120,
+                          **kw)
+
+
+class TestCLI:
+    def test_graph_list_rules(self):
+        r = _run("-m", "tpu_dist.analysis", "graph", "--list-rules")
+        assert r.returncode == 0
+        for code in GRAPH_RULE_DOCS:
+            assert code in r.stdout
+
+    def test_shipped_example_ships_green_exit_0(self):
+        r = _run("-m", "tpu_dist.analysis", "graph",
+                 "--graph", "examples/actor_learner.py:build_graph",
+                 "--graph-args", "[4]")
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "0 error(s), 0 warning(s)" in r.stdout
+
+    def test_deadlocking_fixture_rejected_with_witness(self, tmp_path):
+        script = tmp_path / "dead.py"
+        script.write_text(_DEADLOCK_SCRIPT)
+        r = _run("-m", "tpu_dist.analysis", "graph", str(script),
+                 "--roles", "a:1,b:1")
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "TD101" in r.stdout
+        assert "witness schedule" in r.stdout
+        assert "wait-for cycle" in r.stdout
+
+    def test_graph_json_schema(self, tmp_path):
+        script = tmp_path / "dead.py"
+        script.write_text(_DEADLOCK_SCRIPT)
+        r = _run("-m", "tpu_dist.analysis", "graph", str(script),
+                 "--roles", "a:1,b:1", "--format", "json")
+        assert r.returncode == 1
+        doc = json.loads(r.stdout)
+        assert doc["version"] == 1 and doc["tool"] == "graph"
+        assert doc["counts"]["error"] == 1
+        assert {r["name"] for r in doc["graph"]["roles"]} == {"a", "b"}
+        assert doc["findings"][0]["rule"] == "TD101"
+
+    def test_roles_channels_spec_only_no_script(self):
+        r = _run("-m", "tpu_dist.analysis", "graph",
+                 "--roles", "a:1,b:1",
+                 "--channels", "fwd:a>b:2,bwd:b>a:2")
+        assert r.returncode == 1 and "TD101" in r.stdout
+
+    def test_usage_error_exit_2(self):
+        r = _run("-m", "tpu_dist.analysis", "graph")
+        assert r.returncode == 2 and "no graph source" in r.stderr
+
+    @pytest.mark.multiprocess
+    def test_launcher_verify_graph_refuses_deadlock(self, tmp_path):
+        # the pre-flight runs (and refuses) before anything spawns, so
+        # this subprocess is cheap despite going through the launcher
+        script = tmp_path / "dead.py"
+        script.write_text(_DEADLOCK_SCRIPT)
+        r = _run("-m", "tpu_dist.launch", "--roles", "a:1,b:1",
+                 "--verify_graph", str(script))
+        assert r.returncode == 2, r.stdout + r.stderr
+        assert "TD101" in r.stderr
+        assert "witness schedule" in r.stderr
+        assert "refusing to launch" in r.stderr

@@ -1,0 +1,372 @@
+"""Offline trace-replay sanitizer (tpu_dist.analysis.replay).
+
+Synthetic-dump unit matrix for the TD110 rule family — lockstep
+collective divergence (TD110), store-key lifecycle (TD111), channel
+cursor invariants incl. the PR 12 orphaned-claim limit (TD112),
+hole-skip vs late-write loss (TD113), serve plan/ack pairing (TD114),
+and the post-hoc hang verdict (TD115) — plus the CLI exit-code/JSON
+schema contract shared with ``obs diagnose --json``, and a LIVE
+multi-consumer orphaned-claim run: a real Channel endpoint abandons a
+claim under an armed flight recorder and the replay of its dump names
+the orphan.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tpu_dist import obs
+from tpu_dist.analysis import replay_dumps, replay_dir
+
+pytestmark = [pytest.mark.analysis]
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- synthetic dump builders --------------------------------------------------
+
+
+def _dump(rank, events, world=2, gen=0, reason="exit"):
+    return {"version": 1, "rank": rank, "world": world, "generation": gen,
+            "reason": reason, "events": list(events)}
+
+
+def _coll(i, op="all_reduce", outcome="ok", **kw):
+    ev = {"kind": "collective", "op": op, "coll": i, "outcome": outcome,
+          "site": "worker.py:10"}
+    if op == "all_reduce":
+        ev.setdefault("reduce", "sum")
+    ev.update(kw)
+    return ev
+
+
+def _lockstep(n, **kw):
+    return [_coll(i, **kw) for i in range(n)]
+
+
+def _rules(report):
+    return sorted(f.rule for f in report.findings)
+
+
+# -- clean runs ---------------------------------------------------------------
+
+
+class TestCleanRuns:
+    def test_healthy_run_has_no_findings(self):
+        rep = replay_dumps([_dump(0, _lockstep(3)),
+                            _dump(1, _lockstep(3))])
+        assert rep.findings == []
+        assert rep.diagnosis["verdict"] == "healthy"
+        assert rep.ranks == [0, 1]
+
+    def test_empty_is_reportable(self):
+        rep = replay_dumps([])
+        assert rep.ranks == [] and rep.findings == []
+
+
+# -- TD110: lockstep collective divergence ------------------------------------
+
+
+class TestCollectiveDivergence:
+    def test_op_mismatch_at_one_seq(self):
+        rep = replay_dumps([
+            _dump(0, _lockstep(2) + [_coll(2, op="broadcast")]),
+            _dump(1, _lockstep(3))])
+        td110 = [f for f in rep.findings if f.rule == "TD110"]
+        assert td110 and td110[0].severity == "error"
+        assert "collective #2" in td110[0].message
+        assert "broadcast" in td110[0].message
+        assert "all_reduce" in td110[0].message
+
+    def test_reduce_op_mismatch(self):
+        rep = replay_dumps([
+            _dump(0, [_coll(0, reduce="sum")]),
+            _dump(1, [_coll(0, reduce="max")])])
+        td110 = [f for f in rep.findings if f.rule == "TD110"]
+        assert td110 and "reduce" in td110[0].message
+
+    def test_digest_mismatch_on_all_reduce(self):
+        rep = replay_dumps([
+            _dump(0, [_coll(0, digest="256xf32")]),
+            _dump(1, [_coll(0, digest="128xf32")])])
+        td110 = [f for f in rep.findings if f.rule == "TD110"]
+        assert td110 and "digest" in td110[0].message
+
+    def test_single_rank_at_a_seq_is_not_compared(self):
+        # straggler never reached #2: nothing to linearize there
+        rep = replay_dumps([_dump(0, _lockstep(3)),
+                            _dump(1, _lockstep(2))])
+        assert "TD110" not in _rules(rep)
+
+
+# -- TD111: store-key lifecycle -----------------------------------------------
+
+
+class TestStoreLifecycle:
+    def test_cross_generation_access_is_error(self):
+        rep = replay_dumps([_dump(0, _lockstep(1) + [
+            {"kind": "store", "op": "set", "key": "tpu_dist/g0/ch/x"}],
+            gen=2), _dump(1, _lockstep(1), gen=2)])
+        td111 = [f for f in rep.findings if f.rule == "TD111"]
+        assert td111 and td111[0].severity == "error"
+        assert "generation" in td111[0].message
+
+    def test_write_after_prefix_reap_warns(self):
+        rep = replay_dumps([_dump(0, _lockstep(1) + [
+            {"kind": "store", "op": "delete_prefix",
+             "key": "tpu_dist/g0/ch/work"},
+            {"kind": "store", "op": "set",
+             "key": "tpu_dist/g0/ch/work/m/3"}]),
+            _dump(1, _lockstep(1))])
+        td111 = [f for f in rep.findings if f.rule == "TD111"]
+        assert td111 and "after reaping" in td111[0].message
+
+    def test_subgroup_key_from_non_member_warns(self):
+        # grp1 membership {0, 1} is recovered from the group-collective
+        # labels; rank 2 touching its namespace is the violation
+        member_ev = _coll(0, group="grp1[0, 1]")
+        rep = replay_dumps([
+            _dump(0, [member_ev], world=3),
+            _dump(1, [member_ev], world=3),
+            _dump(2, [_coll(0), {"kind": "store", "op": "add",
+                                 "key": "tpu_dist/g0/grp1/seq"}],
+                  world=3)])
+        td111 = [f for f in rep.findings if f.rule == "TD111"]
+        assert td111 and "grp1" in td111[0].message
+        assert "rank 2" in td111[0].message
+
+    def test_failover_pseudo_key_is_exempt(self):
+        # op="failover" carries the promoted leader ADDRESS in "key" —
+        # it must not trip the namespace checks, and the diagnosis must
+        # surface the control-plane move by name
+        rep = replay_dumps([_dump(0, _lockstep(2) + [
+            {"kind": "store", "op": "failover", "key": "127.0.0.1:9102",
+             "old": "127.0.0.1:9101", "epoch": 1}]),
+            _dump(1, _lockstep(2))])
+        assert "TD111" not in _rules(rep)
+        assert rep.diagnosis["store_failovers"] == [
+            {"rank": 0, "leader": "127.0.0.1:9102",
+             "old": "127.0.0.1:9101", "epoch": 1}]
+
+
+# -- TD112/TD113: channel cursor invariants -----------------------------------
+
+
+def _ch(op, slot, channel="work"):
+    return {"kind": "channel", "op": op, "slot": slot, "channel": channel}
+
+
+class TestChannelCursor:
+    def test_clean_put_claim_ack_cycle(self):
+        rep = replay_dumps([
+            _dump(1, _lockstep(1) + [_ch("put", 0), _ch("put", 1)]),
+            _dump(0, _lockstep(1) + [_ch("claim", 0), _ch("ack", 0),
+                                     _ch("claim", 1), _ch("consume", 1)])])
+        assert "TD112" not in _rules(rep) and "TD113" not in _rules(rep)
+
+    def test_orphaned_claim_named(self):
+        # the PR 12 documented limit: a rank killed holding a
+        # multi-consumer claim leaves claim (or abandon) with no
+        # resolution and no return — replay must name it
+        rep = replay_dumps([
+            _dump(1, [_coll(0), _ch("put", 0)]),
+            _dump(0, [_coll(0), _ch("claim", 0)])])
+        td112 = [f for f in rep.findings if f.rule == "TD112"]
+        assert td112 and td112[0].severity == "warning"
+        assert "orphaned claim" in td112[0].message
+        assert "'work'" in td112[0].message and "slot 0" in td112[0].message
+
+    def test_returned_claim_is_not_an_orphan(self):
+        rep = replay_dumps([
+            _dump(0, [_coll(0), _ch("claim", 0), _ch("claim-return", 0)])])
+        assert "TD112" not in _rules(rep)
+
+    def test_double_ack_is_error(self):
+        rep = replay_dumps([
+            _dump(0, [_coll(0), _ch("claim", 2), _ch("ack", 2)]),
+            _dump(3, [_coll(0), _ch("inherit", 2), _ch("consume", 2)],
+                  world=4)])
+        td112 = [f for f in rep.findings if f.rule == "TD112"
+                 and f.severity == "error"]
+        assert td112 and "double-ack" in td112[0].message
+
+    def test_hole_skip_with_recorded_write_is_lost_message(self):
+        rep = replay_dumps([
+            _dump(1, [_coll(0), _ch("put", 5)]),
+            _dump(0, [_coll(0), _ch("hole-skip", 5)])])
+        td113 = [f for f in rep.findings if f.rule == "TD113"]
+        assert td113 and "lost" in td113[0].message
+
+    def test_hole_skip_without_write_is_the_healed_case(self):
+        rep = replay_dumps([
+            _dump(0, [_coll(0), _ch("hole-skip", 5)])])
+        assert "TD113" not in _rules(rep)
+
+
+# -- TD114: serve plan/ack pairing --------------------------------------------
+
+
+def _plan(op, **kw):
+    return dict({"kind": "plan", "op": op}, **kw)
+
+
+class TestPlanPairing:
+    def test_follower_plan_seq_gap(self):
+        rep = replay_dumps([
+            _dump(1, [_coll(0)] + [
+                _plan("apply", plan_seq=s, plan="decode")
+                for s in (1, 2, 4, 5)])])
+        td114 = [f for f in rep.findings if f.rule == "TD114"]
+        assert td114 and "[3]" in td114[0].message
+        assert "rank 1" in td114[0].message
+
+    def test_contiguous_plan_stream_is_clean(self):
+        rep = replay_dumps([
+            _dump(1, [_coll(0)] + [
+                _plan("apply", plan_seq=s, plan="decode")
+                for s in (1, 2, 3)])])
+        assert "TD114" not in _rules(rep)
+
+    def test_dispatch_without_arrival(self):
+        rep = replay_dumps([
+            _dump(0, [_coll(0), _plan("dispatch", req=7)]),
+            _dump(1, [_coll(0), _plan("dispatch", req=8),
+                      _plan("arrive", req=8, outcome="ok")])])
+        td114 = [f for f in rep.findings if f.rule == "TD114"]
+        assert len(td114) == 1 and "req='7'" in td114[0].message
+
+
+# -- TD115: post-hoc hang verdict ---------------------------------------------
+
+
+class TestHangVerdict:
+    def test_straggler_named_with_rank_and_seq(self):
+        rep = replay_dumps([
+            _dump(0, _lockstep(4) + [_coll(4, outcome="pending")]),
+            _dump(1, _lockstep(4))])
+        td115 = [f for f in rep.findings if f.rule == "TD115"]
+        assert td115 and td115[0].severity == "error"
+        assert "rank 1" in td115[0].message
+        assert "#4" in td115[0].message
+        assert "worker.py:10" in td115[0].message
+        assert rep.diagnosis["verdict"] == "straggler"
+
+    def test_missing_rank_is_a_warning(self):
+        rep = replay_dumps([_dump(0, _lockstep(2), world=3),
+                            _dump(1, _lockstep(2), world=3)])
+        td115 = [f for f in rep.findings if f.rule == "TD115"]
+        assert td115 and td115[0].severity == "warning"
+        assert "[2]" in td115[0].message
+
+
+# -- report schema + CLI ------------------------------------------------------
+
+
+def _write_dumps(dir_path, dumps):
+    os.makedirs(dir_path, exist_ok=True)
+    for d in dumps:
+        name = f"obs_g{d['generation']}_r{d['rank']}.json"
+        with open(os.path.join(dir_path, name), "w") as f:
+            json.dump(d, f)
+
+
+def _cli(*args):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, "-m", "tpu_dist.analysis", "replay", *args],
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=120)
+
+
+class TestReportAndCLI:
+    def test_report_json_shares_the_diagnose_schema(self):
+        rep = replay_dumps([_dump(0, _lockstep(2)),
+                            _dump(1, _lockstep(2))], path="/tmp/x")
+        doc = rep.to_json()
+        assert doc["version"] == 1 and doc["tool"] == "replay"
+        # same envelope keys as `obs diagnose --json`, plus findings
+        for key in ("path", "generation", "ranks", "diagnosis",
+                    "findings", "counts"):
+            assert key in doc, key
+        assert doc["diagnosis"]["verdict"] == "healthy"
+
+    def test_replay_dir_picks_newest_generation(self, tmp_path):
+        _write_dumps(str(tmp_path), [_dump(0, _lockstep(1), gen=0),
+                                     _dump(0, _lockstep(3), gen=1,
+                                           world=1)])
+        rep = replay_dir(str(tmp_path))
+        assert rep.generation == 1
+        assert replay_dir(str(tmp_path), generation=0).generation == 0
+
+    def test_cli_clean_exit_0(self, tmp_path):
+        _write_dumps(str(tmp_path), [_dump(0, _lockstep(2)),
+                                     _dump(1, _lockstep(2))])
+        r = _cli(str(tmp_path))
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "ranks [0, 1]" in r.stdout
+
+    def test_cli_findings_exit_1_and_json_schema(self, tmp_path):
+        _write_dumps(str(tmp_path), [
+            _dump(0, _lockstep(4) + [_coll(4, outcome="pending")]),
+            _dump(1, _lockstep(4))])
+        r = _cli(str(tmp_path))
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "TD115" in r.stdout
+        rj = _cli(str(tmp_path), "--format", "json")
+        assert rj.returncode == 1
+        doc = json.loads(rj.stdout)
+        assert doc["tool"] == "replay" and doc["version"] == 1
+        assert doc["diagnosis"]["straggler"] == 1
+        assert doc["counts"]["error"] == 1
+
+    def test_cli_no_dumps_exit_2(self, tmp_path):
+        r = _cli(str(tmp_path))
+        assert r.returncode == 2 and "no flight-recorder dumps" in r.stderr
+
+    def test_cli_list_rules(self):
+        r = _cli("--list-rules")
+        assert r.returncode == 0
+        for code in ("TD110", "TD112", "TD115"):
+            assert code in r.stdout
+
+
+# -- LIVE orphaned claim: real Channel + armed recorder -----------------------
+
+
+@pytest.mark.roles
+def test_live_multi_consumer_orphaned_claim_is_named(monkeypatch,
+                                                     tmp_path):
+    """A real multi-consumer Channel endpoint claims a slot no producer
+    ever writes; its get() deadline abandons the claim (multi-consumer
+    claims cannot be returned — the PR 12 limit).  The armed flight
+    recorder captures the cursor events, and replaying the dump names
+    the orphaned claim on that channel and slot."""
+    from tpu_dist.dist.store import TCPStore
+    from tpu_dist.roles.channel import Channel, ChannelTimeoutError
+    from tpu_dist.roles.graph import ChannelSpec
+
+    monkeypatch.setenv("TPU_DIST_OBS", "1")
+    monkeypatch.setenv("TPU_DIST_OBS_DIR", str(tmp_path))
+    obs.reset()
+    store = TCPStore(is_master=True)
+    try:
+        spec = ChannelSpec("work", src="prod", dst="pool", depth=4)
+        cons = Channel(spec, store, rank=0, role="pool",
+                       src_span=[2], dst_span=[0, 1], generation=0,
+                       graph_world=3)
+        with pytest.raises(ChannelTimeoutError):
+            cons.get(timeout=0.5)
+        obs.get_recorder().dump("test", dir=str(tmp_path))
+    finally:
+        store.close()
+        obs.reset()
+
+    rep = replay_dir(str(tmp_path))
+    assert rep.ranks, "no dump written"
+    td112 = [f for f in rep.findings if f.rule == "TD112"]
+    assert td112, rep.findings
+    assert "orphaned claim" in td112[0].message
+    assert "'work'" in td112[0].message and "slot 0" in td112[0].message

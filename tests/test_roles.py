@@ -697,9 +697,14 @@ def test_actor_learner_e2e_solo_restart_and_loss_decrease(tmp_path):
     queue and the learner consumes them.  Loss decreases."""
     out = tmp_path / "al"
     out.mkdir()
+    obs_dir = tmp_path / "obsdumps"
     env = dict(os.environ)
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
+    # armed flight recorder: every worker dumps its channel/store events
+    # so the replay sanitizer can re-verify the protocol after the run
+    env["TPU_DIST_OBS"] = "1"
+    env["TPU_DIST_OBS_DIR"] = str(obs_dir)
     # kill actor[1] (global rank 2) at its 3rd produced batch — SIGKILL,
     # no teardown, exactly the preemption shape solo restart exists for
     env["TPU_DIST_CHAOS"] = "kill:rank=2,step=3"
@@ -739,6 +744,24 @@ def test_actor_learner_e2e_solo_restart_and_loss_decrease(tmp_path):
 
     # (d) big batches rode the data plane, envelopes the sealed store
     assert learner["traj_stats"]["dp_msgs"] > 0, learner["traj_stats"]
+
+    # (e) offline replay of the dumps re-verifies the channel protocol:
+    # real put/claim/ack cursor events were recorded, and the SIGKILL +
+    # solo restart left no accounting errors — no double-acked slot
+    # (TD112) and no cross-generation store access (TD111).  The killed
+    # incarnation leaves no dump, so its events are absent, not wrong.
+    from tpu_dist import obs
+    from tpu_dist.analysis import replay_dir
+    dumps = obs.read_dumps(str(obs_dir))
+    assert dumps, "no flight-recorder dumps written"
+    ch_ops = {e.get("op") for d in dumps for e in d["events"]
+              if e.get("kind") == "channel"}
+    assert "put" in ch_ops and "claim" in ch_ops and "ack" in ch_ops, \
+        ch_ops
+    rep = replay_dir(str(obs_dir))
+    errors = [f for f in rep.findings if f.severity == "error"
+              and f.rule in ("TD111", "TD112")]
+    assert not errors, [f.message for f in errors]
 
 
 # ---------------------------------------------------------------------------

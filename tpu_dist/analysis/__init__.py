@@ -1,9 +1,9 @@
 """tpu_dist.analysis — distributed-correctness tooling.
 
-Two halves (docs/analysis.md):
+Four tools (docs/analysis.md):
 
 - **tpudlint**, a static AST pass over tpu_dist programs
-  (``python -m tpu_dist.analysis <paths>``): six rule classes (TD001–TD006)
+  (``python -m tpu_dist.analysis <paths>``): rule classes TD001–TD010
   for the hazards that silently deadlock an eager-SPMD world — collectives
   under rank conditionals, divergent collective sequences, un-namespaced
   store keys, deadline-less blocking waits, host side effects under
@@ -15,18 +15,40 @@ Two halves (docs/analysis.md):
   through the generation-scoped store before executing, raising
   :class:`CollectiveMismatchError` naming the divergent rank and call-site
   within a bounded deadline instead of hanging.
+- the **static whole-graph protocol verifier** (protocol.py,
+  ``python -m tpu_dist.analysis graph``, launcher ``--verify-graph``):
+  model-checks a RoleGraph + ChannelSpec topology — bounded-channel
+  deadlock cycles with a printed witness schedule, claim-safety under
+  solo restarts, restart-policy soundness, dp-path feasibility
+  (TD101–TD105).
+- the **offline trace-replay sanitizer** (replay.py,
+  ``python -m tpu_dist.analysis replay <dump-dir>``): re-verifies a
+  flight-recorder dump post-hoc — lockstep collective linearization,
+  store-key lifecycle, channel cursor invariants (orphaned claims,
+  double-acks, hole-skip/late-write conflicts), serve plan/ack pairing
+  (TD110–TD115) — sharing one JSON schema with ``obs diagnose``.
 
 veScale's argument (PAPERS.md) is that eager-mode SPMD needs consistency
 *checking*, not just consistent primitives; Launchpad's is that a
-program-level representation enables tooling.  tpudlint is the
-program-level half, the sanitizer the runtime half.
+program-level representation enables tooling.  tpudlint and the graph
+verifier are the program-level half, the sanitizers the runtime half —
+and replay closes the loop by re-running the runtime checks over what a
+crashed job actually did.
 """
 
 from .findings import Finding, render_json, render_text
 from .linter import lint_file, lint_paths, lint_source
+from .protocol import (GRAPH_RULE_DOCS, extract_channel_specs,
+                       parse_channels_spec, verify_graph)
+from .replay import (REPLAY_RULE_DOCS, ReplayReport, replay_dir,
+                     replay_dumps)
 from .rules import RULE_DOCS, RULES
 from .sanitizer import CollectiveMismatchError, check_collective, enabled
 
 __all__ = ["Finding", "lint_source", "lint_file", "lint_paths",
            "render_text", "render_json", "RULES", "RULE_DOCS",
-           "CollectiveMismatchError", "check_collective", "enabled"]
+           "CollectiveMismatchError", "check_collective", "enabled",
+           "GRAPH_RULE_DOCS", "verify_graph", "extract_channel_specs",
+           "parse_channels_spec",
+           "REPLAY_RULE_DOCS", "ReplayReport", "replay_dumps",
+           "replay_dir"]

@@ -99,6 +99,7 @@ class StoreFollower:
         self._client: Optional[_PyClient] = None
         self._stop = threading.Event()
         self._paused = threading.Event()
+        self._tail_mu = threading.Lock()  # held across each tail round
         self._promoted = threading.Event()
         self.leader_lost = threading.Event()
         self._first_fail: Optional[float] = None
@@ -134,26 +135,31 @@ class StoreFollower:
 
     def _tail_loop(self) -> None:
         while not self._stop.is_set() and not self._promoted.is_set():
-            if self._paused.is_set():
-                time.sleep(self._poll)
-                continue
-            try:
-                self._tail_once()
-                self._first_fail = None
-            except (OSError, RuntimeError):
-                # The tail client is at-most-once on LOG_SINCE, so every
-                # failure lands here; leader_lost only after the outage
-                # has spanned down_after — one dropped connection is not a
-                # dead leader.
-                now = time.monotonic()
-                if self._first_fail is None:
-                    self._first_fail = now
-                elif now - self._first_fail >= self.down_after:
-                    self.leader_lost.set()
+            with self._tail_mu:
+                if not self._paused.is_set():
+                    try:
+                        self._tail_once()
+                        self._first_fail = None
+                    except (OSError, RuntimeError):
+                        # The tail client is at-most-once on LOG_SINCE, so
+                        # every failure lands here; leader_lost only after
+                        # the outage has spanned down_after — one dropped
+                        # connection is not a dead leader.
+                        now = time.monotonic()
+                        if self._first_fail is None:
+                            self._first_fail = now
+                        elif now - self._first_fail >= self.down_after:
+                            self.leader_lost.set()
             self._stop.wait(self._poll)
 
     def pause(self) -> None:
+        # Synchronous: a tail round already in flight when the event is
+        # set could still apply mutations that raced in at the leader, so
+        # barrier on the round lock — after return the follower image is
+        # frozen.
         self._paused.set()
+        with self._tail_mu:
+            pass
 
     def resume(self) -> None:
         self._paused.clear()

@@ -213,6 +213,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--solo_restarts", type=int, default=2,
                    help="per-rank respawn budget for 'solo'-policy roles "
                         "within one generation (--roles only)")
+    p.add_argument("--verify_graph", "--verify-graph", action="store_true",
+                   help="statically model-check the role graph before "
+                        "spawning anything (tpu_dist.analysis.protocol, "
+                        "docs/analysis.md): channel topology is extracted "
+                        "from the script's ChannelSpec literals and checked "
+                        "for bounded-queue deadlock cycles (TD101, witness "
+                        "schedule printed), claim-safety, restart-policy "
+                        "and placement soundness.  Any error-severity "
+                        "finding REFUSES the launch with exit 2 "
+                        "(--roles only)")
     p.add_argument("--standalone", action="store_true",
                    help="single-node mode with automatic rendezvous "
                         "(torchrun parity): forces --nnodes=1 "
@@ -390,11 +400,10 @@ def _spawn_world(args, world_size: int, master_port: int,
     except BaseException:
         # includes KeyboardInterrupt mid-loop: already-spawned children
         # would otherwise sit in the rendezvous pre-flight wait for minutes
+        from ..roles.launcher import reap_process
         for p in procs:
             if p.poll() is None:
-                p.kill()
-                # tpudlint: disable=TD004  # reaping a SIGKILLed child
-                p.wait()
+                reap_process(p)
         raise
     return procs
 
@@ -622,6 +631,7 @@ def _watch_world(args, procs: List[subprocess.Popen], store,
         if remote_failed and exit_code == 0:
             exit_code = 1  # this node restarts/exits with the group
     except KeyboardInterrupt:
+        from ..roles.launcher import reap_process
         for p in procs:
             if p.poll() is None:
                 p.send_signal(signal.SIGINT)
@@ -630,9 +640,7 @@ def _watch_world(args, procs: List[subprocess.Popen], store,
             try:
                 p.wait(timeout=max(0.1, deadline - time.monotonic()))
             except subprocess.TimeoutExpired:
-                p.kill()
-                # tpudlint: disable=TD004  # reaping a SIGKILLed child
-                p.wait()
+                reap_process(p)
         exit_code = 130
         interrupted = True
     return exit_code, interrupted, [pre_teardown_rcs.get(i)
@@ -989,6 +997,37 @@ def _cluster_agree(args, store, rnd: int, local_rc: int,
     return ("restart", rc_port)
 
 
+def _verify_role_graph(args) -> int:
+    """``--verify_graph`` pre-flight: statically model-check the role
+    graph + channel topology (tpu_dist.analysis.protocol) BEFORE spawning
+    anything, refusing a provably-hazardous graph.  A TD101 deadlock
+    finding prints its witness schedule — the concrete put/get
+    interleaving that wedges every role in the cycle."""
+    from ..analysis.protocol import build_graph, verify_graph
+
+    src = args.script if (args.script and not args.module
+                          and os.path.exists(args.script)) else None
+    label = src or "<--roles spec>"
+    graph, findings, notes = build_graph(roles_spec=args.roles, script=src,
+                                         path=label)
+    if graph is not None:
+        findings = list(findings) + verify_graph(graph, nnodes=args.nnodes,
+                                                 path=label)
+    for note in notes:
+        sys.stderr.write(f"--verify_graph: note: {note}\n")
+    for f in findings:
+        sys.stderr.write(f.render() + "\n")
+    errors = [f for f in findings if f.severity == "error"
+              and not f.suppressed]
+    if errors:
+        sys.stderr.write(
+            f"--verify_graph: refusing to launch — {len(errors)} "
+            f"error-severity protocol finding(s) above (run "
+            f"'python -m tpu_dist.analysis graph' for the full report)\n")
+        return 2
+    return 0
+
+
 def _run_role_graph(args) -> int:
     """``--roles``: launch a heterogeneous role graph (tpu_dist.roles)
     instead of one SPMD world.  The graph supervisor
@@ -1014,6 +1053,10 @@ def _run_role_graph(args) -> int:
     except RoleGraphError as e:
         sys.stderr.write(f"--roles: {e}\n")
         return 2
+    if args.verify_graph:
+        rc = _verify_role_graph(args)
+        if rc:
+            return rc
     if args.nnodes > 1:
         # multi-node role placement: @node pins decide which launcher
         # supervises which span (unpinned roles are node 0's); every
@@ -1097,9 +1140,8 @@ def _run_role_graph(args) -> int:
             try:
                 gateway_proc.wait(timeout=10)
             except subprocess.TimeoutExpired:
-                gateway_proc.kill()
-                # tpudlint: disable=TD004  # reaping a SIGKILLed child
-                gateway_proc.wait()
+                from ..roles.launcher import reap_process
+                reap_process(gateway_proc)
         if store is not None:
             try:
                 store.close()
@@ -1396,9 +1438,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             try:
                 gateway_proc.wait(timeout=10)
             except subprocess.TimeoutExpired:
-                gateway_proc.kill()
-                # tpudlint: disable=TD004  # reaping a SIGKILLed child
-                gateway_proc.wait()
+                from ..roles.launcher import reap_process
+                reap_process(gateway_proc)
         if store is not None:
             try:
                 store.close()

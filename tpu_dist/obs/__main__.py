@@ -79,7 +79,15 @@ def main(argv=None) -> int:
     if args.cmd == "diagnose":
         diag = _trace.diagnose(dumps)
         if args.json:
-            print(json.dumps(diag, indent=2, sort_keys=True))
+            # versioned envelope shared with `tpu_dist.analysis replay
+            # --format json` (docs/observability.md): the replay document
+            # is this one plus findings/counts, so scripts can read
+            # .diagnosis from either tool
+            doc = {"version": 1, "tool": "diagnose", "path": where,
+                   "generation": dumps[0].get("generation", 0),
+                   "ranks": sorted(d.get("rank", -1) for d in dumps),
+                   "diagnosis": diag}
+            print(json.dumps(doc, indent=2, sort_keys=True))
         else:
             print(_trace.render_diagnosis(diag))
         ok = diag.get("verdict") == "healthy" or (

@@ -162,8 +162,9 @@ class FlightRecorder:
     Thread-safe; the critical section is a sequence-number increment and a
     deque append.  Events are plain dicts (JSON-ready).  Core keys:
     ``seq`` (per-rank event index), ``kind`` (collective | p2p | store |
-    transport | beat | user), ``op``, ``t0``/``t1`` (monotonic ns; ``t1``
-    None while in flight), ``outcome`` (pending | ok | error:Type).
+    transport | beat | serve | channel | plan | user), ``op``,
+    ``t0``/``t1`` (monotonic ns; ``t1`` None while in flight),
+    ``outcome`` (pending | ok | error:Type).
     Collective events additionally carry ``coll`` — the process-local
     collective sequence number every rank of an SPMD program increments in
     lockstep, which is what the cross-rank merge aligns on — plus

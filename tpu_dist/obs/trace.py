@@ -29,12 +29,15 @@ from typing import List, Optional
 __all__ = ["read_dumps", "merge_trace", "diagnose", "render_diagnosis"]
 
 # trace lane per event kind (tid within each rank's track)
-_TID = {"collective": 0, "p2p": 1, "transport": 2, "store": 3, "beat": 4}
+_TID = {"collective": 0, "p2p": 1, "transport": 2, "store": 3, "beat": 4,
+        "channel": 5, "plan": 6}
 _TID_NAMES = {0: "collectives", 1: "p2p", 2: "transport", 3: "store",
-              4: "beats", 5: "other"}
+              4: "beats", 5: "channels", 6: "plans", 7: "other"}
+_OTHER_TID = 7
 _ARG_KEYS = ("seq", "coll", "outcome", "site", "path", "bytes",
              "wire_bytes", "raw_wire_bytes", "comm", "digest", "reduce",
-             "src", "dst", "peer", "key", "step", "detail")
+             "src", "dst", "peer", "key", "step", "detail",
+             "channel", "slot", "plan", "plan_seq", "req", "group")
 
 
 def read_dumps(path, generation: Optional[int] = None) -> List[dict]:
@@ -101,7 +104,7 @@ def merge_trace(dumps: List[dict]) -> dict:
                 "cat": str(e.get("kind", "event")),
                 "ph": "X",
                 "pid": rank,
-                "tid": _TID.get(e.get("kind"), 5),
+                "tid": _TID.get(e.get("kind"), _OTHER_TID),
                 "ts": (t0 + off) / 1e3,
                 "dur": max((t1 - t0) / 1e3, 0.001),
                 "args": {k: e[k] for k in _ARG_KEYS if e.get(k) is not None},

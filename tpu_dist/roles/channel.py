@@ -221,9 +221,12 @@ class Channel:
             # indistinguishable from an orphan), at attach time (no own
             # get can be in flight yet)
             try:
-                stranded = self._count("rtail") - self._count("acks")
+                rtail = self._count("rtail")
+                stranded = rtail - self._count("acks")
                 if stranded > 0:
                     self._store.add(self._k("rtail"), -stranded)
+                    for i in range(rtail - stranded, rtail):
+                        self._obs("claim-return", slot=i)
             except Exception:
                 pass
         elif (spec.kind == "queue" and self._role == spec.dst
@@ -247,6 +250,7 @@ class Channel:
                     # starts it once a producer has claimed the slot
                     self._abandoned.setdefault(i, [None, _hole_settle()])
                     self._claims.add(i)
+                    self._obs("inherit", slot=i)
                 if inherited:
                     from ..utils.logging import log_event
                     log_event("roles-channel-claims-reconciled",
@@ -295,6 +299,14 @@ class Channel:
 
     def _k(self, leaf: str) -> str:
         return f"{self._base}/{leaf}"
+
+    def _obs(self, op: str, **fields) -> None:
+        """Flight-record one cursor transition (kind=``channel``) — the
+        event stream the offline replay sanitizer re-verifies (claim
+        without ack = orphaned claim, double-ack, hole-skip vs
+        late-write).  No-op unless the recorder is armed; never raises."""
+        from ..obs.recorder import safe_record
+        safe_record("channel", op, channel=self.name, **fields)
 
     def _count(self, leaf: str) -> int:
         return int(self._store.add(self._k(leaf), 0))
@@ -382,6 +394,7 @@ class Channel:
         try:
             self._store.delete_key(key)
             self._store.add(self._k("acks"), 1)
+            self._obs("consume", slot=idx)
         except Exception:
             pass
         self._claim_done(idx)
@@ -568,6 +581,7 @@ class Channel:
             delay = min(delay * 2, 0.02)
         idx = int(self._store.add(self._k("head"), 1)) - 1
         self._store.set(self._k(f"m/{idx}"), self._encode(tree, idx))
+        self._obs("put", slot=idx)
         self.stats["put"] += 1
         return idx
 
@@ -586,6 +600,7 @@ class Channel:
             if got is not _NOTHING:
                 return got
         idx = int(self._store.add(self._k("rtail"), 1)) - 1
+        self._obs("claim", slot=idx)
         if len(self._dst) != 1:
             self._claim_add(idx)
         key = self._k(f"m/{idx}")
@@ -633,6 +648,7 @@ class Channel:
             if len(self._dst) == 1:
                 try:
                     self._store.add(self._k("rtail"), -1)
+                    self._obs("claim-return", slot=idx)
                 except Exception:
                     pass
             else:
@@ -642,6 +658,7 @@ class Channel:
                 # it — instead of leaking the backpressure window
                 self._abandoned.setdefault(
                     idx, [time.monotonic(), _hole_settle()])
+                self._obs("abandon", slot=idx)
             raise
         try:
             out = self._decode(raw, idx, deadline)
@@ -649,6 +666,7 @@ class Channel:
             if len(self._dst) == 1:
                 # received frames stay held in self._partial for the retry
                 self._store.add(self._k("rtail"), -1)
+                self._obs("claim-return", slot=idx)
                 raise
             self._consume_slot(idx, key)  # multi-consumer: lossy timeout
             raise
@@ -662,9 +680,11 @@ class Channel:
             # a surviving (or respawned) single consumer retries losslessly
             if len(self._dst) == 1:
                 self._store.add(self._k("rtail"), -1)
+                self._obs("claim-return", slot=idx)
             raise
         self._store.delete_key(key)
         self._store.add(self._k("acks"), 1)
+        self._obs("ack", slot=idx)
         self._stuck.pop(idx, None)
         self._claim_done(idx)
         self.stats["got"] += 1
@@ -702,6 +722,7 @@ class Channel:
                         self._stuck.pop(idx, None)
                         return
                     self._store.add(self._k("acks"), 1)
+                    self._obs("hole-skip", slot=idx)
                     self._stuck.pop(idx, None)
                     from ..utils.logging import log_event
                     log_event("roles-channel-hole-skipped",
@@ -719,6 +740,7 @@ class Channel:
                 # ack the hole once the settle window passes
                 self._abandoned.setdefault(
                     idx, [now, max(floor, deadline_len)])
+                self._obs("abandon", slot=idx)
         elif len(self._dst) != 1:
             # multi-consumer claim on a slot NO producer has claimed yet:
             # remember it too, but with the settle clock deferred until a
@@ -726,12 +748,14 @@ class Channel:
             # whatever a live producer eventually writes there
             self._abandoned.setdefault(
                 idx, [None, max(_hole_settle(), deadline_len)])
+            self._obs("abandon", slot=idx)
         if len(self._dst) == 1:
             # single consumer: release the claim so a recovered caller
             # retries the SAME slot instead of skipping it (multi-consumer
             # claims cannot be returned safely — a sibling may already
             # have claimed past us)
             self._store.add(self._k("rtail"), -1)
+            self._obs("claim-return", slot=idx)
         raise self._timeout_error(
             f"get (slot {idx})", deadline_len, peer_role)
 
@@ -761,6 +785,7 @@ class Channel:
             if now - entry[0] >= entry[1]:
                 self._abandoned.pop(idx, None)
                 self._store.add(self._k("acks"), 1)
+                self._obs("hole-skip", slot=idx)
                 self._claim_done(idx)
                 from ..utils.logging import log_event
                 log_event("roles-channel-hole-skipped", channel=self.name,
@@ -850,6 +875,7 @@ class Channel:
             # restarts and partially-attached roles (a rank closing twice
             # must not fake a second rank's EOF)
             self._store.set(self._k(f"closed/{self._rank}"), b"1")
+            self._obs("close")
         except Exception:
             pass
 

@@ -130,12 +130,29 @@ class ChannelSpec:
     - ``"latest"`` — a versioned register (``put_latest`` overwrites,
       ``get_latest`` waits for a newer version): the parameter-broadcast
       shape, where consumers want the freshest value, not every value.
+
+    Verification hints (consumed by the static graph verifier,
+    ``python -m tpu_dist.analysis graph`` / ``--verify-graph``; both are
+    pure annotations with no runtime effect):
+
+    - ``payload_bytes`` — expected per-message array payload.  With a
+      multi-rank consumer role the payload cannot ride the p2p lane, so
+      a hint at/above ``TPU_DIST_DP_THRESHOLD`` makes the verifier name
+      the store funnel (TD104) instead of production discovering it.
+    - ``drain`` — how the consumer role services this channel:
+      ``"inline"`` in its main loop (default), or ``"dedicated"`` — the
+      role drains it on a dedicated thread that never blocks in the
+      role's own puts (e.g. the disagg decode leader's KV receive
+      loop).  Dedicated-drain edges cannot be the blocked link of a
+      bounded-channel wait-for cycle, so TD101 excludes them.
     """
     name: str
     src: str
     dst: str
     depth: int = 8
     kind: str = "queue"
+    payload_bytes: Optional[int] = None
+    drain: str = "inline"
 
     def __post_init__(self):
         _check_name("channel", self.name)
@@ -147,6 +164,16 @@ class ChannelSpec:
             raise RoleGraphError(
                 f"channel {self.name!r} needs a positive depth, got "
                 f"{self.depth!r}")
+        if self.drain not in ("inline", "dedicated"):
+            raise RoleGraphError(
+                f"channel {self.name!r}: drain {self.drain!r} must be "
+                f"'inline' or 'dedicated'")
+        if self.payload_bytes is not None and (
+                not isinstance(self.payload_bytes, int)
+                or self.payload_bytes <= 0):
+            raise RoleGraphError(
+                f"channel {self.name!r}: payload_bytes "
+                f"{self.payload_bytes!r} must be a positive byte count")
 
 
 class RoleGraph:

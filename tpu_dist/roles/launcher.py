@@ -48,9 +48,29 @@ from typing import Dict, List, Optional, Sequence
 
 from .graph import RoleGraph, down_key, map_key
 
-__all__ = ["spawn_graph", "local_ranks_of"]
+__all__ = ["spawn_graph", "local_ranks_of", "reap_process"]
 
 _KILL_GRACE = 15.0
+# after SIGKILL the only thing left to wait for is the kernel reaping the
+# zombie entry; seconds of budget is already paranoid
+_REAP_GRACE = 5.0
+
+
+def reap_process(proc: subprocess.Popen, grace: float = _REAP_GRACE) -> None:
+    """SIGKILL ``proc`` (if still alive) and reap it with a bounded wait.
+
+    The deadline matters even post-KILL: an unkillable (``D``-state) child
+    would otherwise hang the supervisor on ``wait()`` forever — here the
+    worst case is a leaked zombie plus a log line, which the supervisor
+    can survive and name.
+    """
+    try:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait(timeout=grace)
+    except Exception:
+        _log(f"reap_process: pid {proc.pid} did not reap within "
+             f"{grace}s of SIGKILL (unkillable child?); abandoning")
 # bound on the cross-launcher round agreement when THIS node already
 # failed (peers tear down within ~one poll interval + kill grace, so a
 # peer missing past this is a vanished machine, not a slow one)
@@ -133,9 +153,7 @@ def _teardown(procs: Dict[int, subprocess.Popen]) -> None:
     for p in procs.values():
         while p.poll() is None:
             if time.monotonic() > deadline:
-                p.kill()
-                # tpudlint: disable=TD004  # reaping a SIGKILLed child
-                p.wait()
+                reap_process(p)
                 break
             time.sleep(0.05)
 
@@ -302,9 +320,7 @@ def spawn_graph(graph: RoleGraph, argv: Sequence[str],
                             _log(f"RankLostError: {lost} "
                                  f"(role {graph.label(r)}, "
                                  f"policy '{policy}')")
-                            procs[r].kill()
-                            # tpudlint: disable=TD004  # reaping SIGKILLed child
-                            procs[r].wait()
+                            reap_process(procs[r])
                             if policy == "solo" and solo_budget[r] > 0:
                                 solo_budget[r] -= 1
                                 incarnation[r] += 1

@@ -102,8 +102,14 @@ def disagg_graph(n_prefill: int, n_decode: int, queue_depth: int = 64,
              Role(ROLE_DECODE, n_decode, restart=restart_decode)]
     channels = [ChannelSpec(PREFILL_QUEUE, src=ROLE_DECODE,
                             dst=ROLE_PREFILL, depth=queue_depth)]
+    # drain="dedicated": the decode leader's _recv_loop thread drains
+    # the kv queues even while the dispatch path is blocked putting on
+    # prefill-q, which is what keeps the prefill<->decode channel cycle
+    # deadlock-free (the graph verifier's TD101 relies on this
+    # annotation to exclude the kv edges from wait-for cycles)
     channels += [ChannelSpec(kv_channel(d), src=ROLE_PREFILL,
-                             dst=ROLE_DECODE, depth=queue_depth)
+                             dst=ROLE_DECODE, depth=queue_depth,
+                             drain="dedicated")
                  for d in range(n_decode)]
     return RoleGraph(roles, channels)
 
@@ -215,6 +221,8 @@ class DisaggSlotEngine(SlotEngine):
             while not self._stop.is_set():
                 try:
                     self._dispatch_ch.put(desc, timeout=2.0)
+                    from ..obs.recorder import safe_record
+                    safe_record("plan", "dispatch", req=int(desc["id"]))
                     break
                 except TimeoutError:
                     continue            # backpressured: keep trying
@@ -269,6 +277,10 @@ class DisaggSlotEngine(SlotEngine):
                 arrival["src"] = src
             except Exception as e:
                 arrival = e             # stage() re-raises it by name
+            from ..obs.recorder import safe_record
+            safe_record("plan", "arrive", req=rid,
+                        outcome=("ok" if not isinstance(arrival, Exception)
+                                 else f"error:{type(arrival).__name__}"))
             with self._cv:
                 self._arrived[rid] = arrival
                 self._cv.notify_all()

@@ -671,6 +671,7 @@ class ShardedSlotEngine(SlotEngine):
         self._poisoned: Optional[BaseException] = None
         self._bcast_mu = threading.Lock()
         self._last_plan = time.monotonic()
+        self._plan_seq = 0
         super().__init__(decoder.slm, decoder.params, num_slots=num_slots,
                          max_len=max_len, cache_dtype=cache_dtype,
                          min_bucket=min_bucket)
@@ -697,6 +698,12 @@ class ShardedSlotEngine(SlotEngine):
         dec = self.decoder
         if dec.world <= 1:
             return
+        # monotone plan seq rides in the frame: followers flight-record
+        # it on apply, so the offline replay sanitizer can pair every
+        # leader send against each follower's applied stream (a gap =
+        # a missed plan frame = a desynced follower, named post-hoc)
+        self._plan_seq += 1
+        plan = dict(plan, seq=self._plan_seq)
         data = _plan_bytes(plan)
         for dst in range(dec.world):
             if dst == dec.rank:
@@ -706,6 +713,9 @@ class ShardedSlotEngine(SlotEngine):
             except Exception:
                 if not best_effort:
                     raise
+        from ..obs.recorder import safe_record
+        safe_record("plan", "send", plan_seq=self._plan_seq,
+                    plan=str(plan.get("op")), dst=dec.world - 1)
         self._last_plan = time.monotonic()
 
     def _pre_admit(self, req: Request, slot: int) -> None:
@@ -925,6 +935,9 @@ class ShardFollower:
     def apply_plan(self, plan: dict) -> bool:
         """Mirror one leader operation; False once the group closed."""
         op = plan.get("op")
+        from ..obs.recorder import safe_record
+        safe_record("plan", "apply", plan_seq=plan.get("seq"),
+                    plan=str(op))
         if op == "admit":
             self._check_slot(plan["slot"])
             self._apply_admit(plan)
