@@ -128,10 +128,10 @@ def _better(new: dict, old: dict) -> dict:
 
 def main() -> None:
     sys.path.insert(0, _REPO)
-    from benchmarks import (attention, bench_roles, bench_serve, generate,
-                            imagenet_e2e, input_pipeline, moe_lm,
-                            resnet_cifar, scaling, transformer_lm,
-                            vit_train)
+    from benchmarks import (attention, bench_pipeline, bench_roles,
+                            bench_serve, generate, imagenet_e2e,
+                            input_pipeline, moe_lm, resnet_cifar, scaling,
+                            transformer_lm, vit_train)
 
     out = os.path.join(_REPO, "BENCH_EXTENDED.json")
     previous = {}
@@ -164,6 +164,7 @@ def main() -> None:
         "serve_sharded": "serve_sharded_tokens_per_sec",
         "serve_disagg": "serve_disagg_tokens_per_sec",
         "roles": "roles_channel_dp_best_mb_s",
+        "pipeline": "pipeline_host_tokens_per_sec",
     }
     import bench  # repo-root headline (MNIST ConvNet) — ratchet a copy here
     results = []
@@ -188,7 +189,8 @@ def main() -> None:
                      ("serve", bench_serve.run),
                      ("serve_sharded", bench_serve.run_sharded),
                      ("serve_disagg", bench_serve.run_disagg),
-                     ("roles", bench_roles.run)):
+                     ("roles", bench_roles.run),
+                     ("pipeline", bench_pipeline.run)):
         try:
             r = fn()
         except Exception as e:  # record the failure, keep the rest running

@@ -291,6 +291,24 @@ def ship(kv, catalog, dst, src, rid, rows):
     return n, w.wait(30.0)
 """
 
+# ISSUE 19 pipeline handle issuers: a stage's async channel send and a
+# trainer's step handle both carry errors that surface only at wait()
+TD007_PIPE_POS = """
+def run(stage, trainer, out_act, h, x, y):
+    stage.send_async(out_act, h, "act mb0")
+    trainer.step(x, y)
+"""
+
+TD007_PIPE_NEG = """
+def run(stage, trainer, engine, optimizer, out_act, h, x, y):
+    s = stage.send_async(out_act, h, "act mb0")
+    metrics = trainer.step(x, y).wait(300)
+    engine.step()                    # non-pipeline receivers: .step() is
+    optimizer.step()                 # not a handle issuer there
+    s.wait(120.0)
+    return metrics
+"""
+
 # serving service-discovery keys are documented cross-generation infra
 TD003_SERVE_NEG = """
 def publish(store, addr):
@@ -467,6 +485,7 @@ class TestRules:
         ("TD005", TD005_POS, TD005_NEG),
         ("TD006", TD006_POS, TD006_NEG),
         ("TD007", TD007_POS, TD007_NEG),
+        ("TD007", TD007_PIPE_POS, TD007_PIPE_NEG),
         ("TD008", TD008_POS, TD008_NEG),
         ("TD009", TD009_POS, TD009_NEG),
         ("TD010", TD010_POS, TD010_NEG),
@@ -655,6 +674,15 @@ class TestRules:
         assert _rules(found) == ["TD007", "TD007"]
         assert all(f.severity == "error" for f in found)
         assert _rules(lint_source(TD007_KV_NEG, "t.py")) == []
+
+    def test_td007_pipeline_stage_send_and_trainer_step(self):
+        # ISSUE 19: PipelineStage.send_async returns a PendingSend whose
+        # backpressure/peer-gone error re-raises at wait(); dropping a
+        # PipelineTrainer.step handle drops the optimizer update itself
+        found = lint_source(TD007_PIPE_POS, "t.py")
+        assert _rules(found) == ["TD007", "TD007"]
+        assert all(f.severity == "error" for f in found)
+        assert _rules(lint_source(TD007_PIPE_NEG, "t.py")) == []
 
     def test_td003_serve_discovery_keys_allowlisted(self):
         # tpu_dist/serve/{backend,gateway} are cross-generation service

@@ -101,6 +101,76 @@ class TestDeadlockCycles:
         assert "p -> q -> p" in text
 
 
+# -- TD101 refinement: credit-disciplined cycles ------------------------------
+
+
+class TestCreditDiscipline:
+    """``ChannelSpec.credits`` declares the producer's in-flight bound.
+    A cycle where EVERY edge is annotated and every depth >= credits is
+    admitted (in-flight <= credits <= depth, puts never block); an
+    annotated edge with depth < credits is refused with the credit
+    witness — the host pipeline's act/grad rings live on this rule."""
+
+    def _ring(self, act_depth, act_credits=3, grad_depth=4,
+              grad_credits=4):
+        return _graph(
+            [Role("stage0", 1), Role("stage1", 1)],
+            [ChannelSpec("act", src="stage0", dst="stage1",
+                         depth=act_depth, credits=act_credits),
+             ChannelSpec("grad", src="stage1", dst="stage0",
+                         depth=grad_depth, credits=grad_credits)])
+
+    def test_fully_annotated_ring_with_depth_geq_credits_is_clean(self):
+        assert verify_graph(self._ring(act_depth=3)) == []
+        assert verify_graph(self._ring(act_depth=8)) == []
+
+    def test_underdepth_annotated_edge_refused_with_credit_witness(self):
+        fs = verify_graph(self._ring(act_depth=1))
+        td101 = [f for f in fs if f.rule == "TD101"]
+        assert len(td101) == 1 and td101[0].severity == "error"
+        msg = td101[0].message
+        assert "credit-annotated queue cycle" in msg
+        assert "under-depth edge(s)" in msg
+        assert "'act'(depth 1 < credits 3)" in msg
+        assert "raise depth to at least credits" in msg
+
+    def test_partially_annotated_cycle_keeps_classic_finding(self):
+        # one unannotated edge: no claim-discipline proof, classic TD101
+        g = _graph([Role("a", 1), Role("b", 1)],
+                   [ChannelSpec("fwd", src="a", dst="b", depth=4,
+                                credits=4),
+                    ChannelSpec("bwd", src="b", dst="a", depth=4)])
+        td101 = [f for f in verify_graph(g) if f.rule == "TD101"]
+        assert len(td101) == 1
+        assert "credit-annotated" not in td101[0].message
+
+    def test_bad_credits_rejected_at_spec_construction(self):
+        with pytest.raises(RoleGraphError):
+            ChannelSpec("x", src="a", dst="b", depth=2, credits=0)
+        with pytest.raises(RoleGraphError):
+            ChannelSpec("x", src="a", dst="b", depth=2, credits="lots")
+
+    def test_pipeline_builder_graphs_admit_both_schedules(self):
+        from tpu_dist.pipeline import build_pipeline_graph
+        for schedule in ("gpipe", "1f1b"):
+            for s, m in ((2, 4), (4, 8), (3, 2)):
+                g = build_pipeline_graph(s, num_microbatches=m,
+                                         schedule=schedule)
+                assert verify_graph(g) == [], (schedule, s, m)
+        # dp lanes: every per-lane ring is separately credit-disciplined
+        assert verify_graph(build_pipeline_graph(3, dp=2)) == []
+
+    def test_extract_channel_specs_reads_credits(self, tmp_path):
+        script = tmp_path / "pipe.py"
+        script.write_text(textwrap.dedent("""
+            from tpu_dist.roles import ChannelSpec
+            ACT = ChannelSpec("act", src="stage0", dst="stage1", depth=4,
+                              credits=4)
+        """))
+        (spec,), _ = extract_channel_specs(str(script))
+        assert spec.credits == 4
+
+
 # -- TD102: claim-safety under solo restarts ----------------------------------
 
 
@@ -256,6 +326,14 @@ class TestShippedGraphsVerifyClean:
             + ":build_graph", "[4]")
         assert verify_graph(g) == []
 
+    def test_pipeline_train(self):
+        # the act/grad rings are real queue cycles admitted ONLY by their
+        # credit annotations (depth == the schedule's claim bound)
+        g = load_graph_builder(
+            os.path.join(_REPO, "examples", "pipeline_train.py")
+            + ":build_graph", "[3]")
+        assert verify_graph(g) == []
+
     def test_serve_disagg(self):
         # the kv channels form a real prefill<->decode cycle broken only
         # by decode's dedicated drain thread — the drain="dedicated"
@@ -329,6 +407,29 @@ class TestCLI:
     def test_usage_error_exit_2(self):
         r = _run("-m", "tpu_dist.analysis", "graph")
         assert r.returncode == 2 and "no graph source" in r.stderr
+
+    @pytest.mark.multiprocess
+    def test_launcher_auto_preflight_refuses_underdepth_pipeline(self):
+        # pipeline launches (>= 2 stageN roles) run the pre-flight
+        # WITHOUT --verify_graph; the launcher loads the example's
+        # build_graph() (builder-constructed specs, invisible to literal
+        # extraction) and refuses the under-depth act ring before spawn
+        env = dict(os.environ)
+        env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["PIPELINE_STAGES"] = "3"
+        env["PIPELINE_ACT_DEPTH"] = "1"
+        r = subprocess.run(
+            [sys.executable, "-m", "tpu_dist.launch",
+             "--roles", "stage0:1,stage1:1,stage2:1",
+             os.path.join(_REPO, "examples", "pipeline_train.py")],
+            cwd=_REPO, env=env, capture_output=True, text=True,
+            timeout=120)
+        assert r.returncode == 2, r.stdout + r.stderr
+        assert "build_graph()" in r.stderr          # the builder was used
+        assert "credit-annotated queue cycle" in r.stderr
+        assert "under-depth" in r.stderr
+        assert "witness schedule" in r.stderr
+        assert "refusing to launch" in r.stderr
 
     @pytest.mark.multiprocess
     def test_launcher_verify_graph_refuses_deadlock(self, tmp_path):

@@ -17,7 +17,13 @@ exactly the Kahn-network boundedness setting, so:
   fills its outgoing queue and then blocks in ``put`` waiting for the next
   role — which is itself blocked.  The finding carries the witness
   schedule, step by step.  ``latest`` edges never block a writer and
-  therefore break cycles.
+  therefore break cycles.  A cycle whose every edge carries a
+  ``ChannelSpec.credits`` annotation (the producer's claim discipline
+  bounds its unacknowledged in-flight messages) is admitted when every
+  edge has ``depth >= credits`` — in-flight never reaches the
+  backpressure wall, so no put on the cycle can block (the 1F1B
+  pipeline's fwd/grad loop); an annotated edge with ``depth < credits``
+  keeps the error, with a credit-overflow witness naming the edge.
 - **TD102** (warning) — claim-safety under restarts: a solo-restarting
   producer can die inside the head-claim/write kill window (holes the
   consumers must settle-ack, losing the message), and a solo-restarting
@@ -59,12 +65,14 @@ from .findings import Finding
 
 __all__ = ["GRAPH_RULE_DOCS", "verify_graph", "extract_channel_specs",
            "parse_channels_spec", "load_graph_builder", "build_graph",
-           "render_witness"]
+           "render_witness", "render_credit_witness"]
 
 GRAPH_RULE_DOCS = {
     "TD101": "bounded-channel wait-for cycle: every role on the cycle can "
              "fill its outgoing queue and block in put() waiting for the "
-             "next blocked role — deadlock, witness schedule printed",
+             "next blocked role — deadlock, witness schedule printed; a "
+             "cycle fully annotated with credits <= depth on every edge "
+             "is admitted (credit-disciplined, puts never block)",
     "TD102": "claim-safety under solo restarts: producer kill-window holes "
              "are settle-acked (message loss), and a killed rank of a "
              "multi-consumer role strands claims until respawn "
@@ -113,6 +121,34 @@ def render_witness(cycle: Sequence[Tuple[str, "object"]]) -> str:
     lines.append(
         f"  wait-for cycle: {ring}; no role can ack while blocked in "
         f"put, so every put times out and no schedule drains the graph")
+    return "\n".join(lines)
+
+
+def render_credit_witness(cycle: Sequence[Tuple[str, "object"]],
+                          over: Sequence[Tuple[str, "object"]]) -> str:
+    """The witness schedule for a credit-annotated cycle with an
+    under-depth edge: the producer's declared in-flight window
+    (``credits``) overflows the channel's ``depth``, so the claim
+    discipline that was supposed to keep the cycle live blocks instead."""
+    lines = ["witness schedule (from the initial empty-channel state):"]
+    step = 1
+    for role, ch in over:
+        lines.append(
+            f"  {step}. {role} opens its declared window: puts "
+            f"{ch.depth} message(s) on {ch.name!r} (depth {ch.depth}) "
+            f"before claiming any inbound ack")
+        step += 1
+        lines.append(
+            f"  {step}. {role} blocks in put #{ch.depth + 1} of its "
+            f"{ch.credits}-credit window on {ch.name!r}: the window "
+            f"does not fit the depth, and its claim discipline only "
+            f"acks inbound edges *between* window puts")
+        step += 1
+    ring = " -> ".join([role for role, _ in cycle] + [cycle[0][0]])
+    lines.append(
+        f"  wait-for cycle: {ring}; the blocked producer never reaches "
+        f"the claim that would ack its inbound edge, so the cycle "
+        f"wedges — raise depth to at least credits on the edge(s) above")
     return "\n".join(lines)
 
 
@@ -229,9 +265,31 @@ def verify_graph(graph, nnodes: Optional[int] = None,
         else _default_dp_threshold()
     roles = {r.name: r for r in graph.roles}
 
-    # TD101: bounded-queue wait-for cycles
+    # TD101: bounded-queue wait-for cycles.  A cycle in which EVERY edge
+    # is credit-annotated is deadlock-free iff every edge has depth >=
+    # credits: the producer's claim discipline keeps in-flight <= credits
+    # <= depth, so no put on the cycle ever reaches the backpressure wall
+    # and no wait-for edge can form (the 1F1B fwd/grad loop).  A single
+    # unannotated edge voids the argument — the classic witness stands.
     for cycle in _find_cycles(graph):
         ring = " -> ".join([r for r, _ in cycle] + [cycle[0][0]])
+        credited = all(getattr(ch, "credits", None) is not None
+                       for _, ch in cycle)
+        if credited:
+            over = [(r, ch) for r, ch in cycle if ch.depth < ch.credits]
+            if not over:
+                continue  # credit-disciplined cycle: puts never block
+            chans = ", ".join(
+                f"{ch.name!r}(depth {ch.depth} < credits {ch.credits})"
+                for _, ch in over)
+            out.append(Finding(
+                "TD101", "error", path, 0, 0,
+                f"bounded-channel deadlock: credit-annotated queue cycle "
+                f"{ring} has under-depth edge(s) {chans} — the producer's "
+                f"declared in-flight window does not fit the channel, so "
+                f"its put blocks mid-window and the cycle's claim "
+                f"discipline wedges\n{render_credit_witness(cycle, over)}"))
+            continue
         chans = ", ".join(f"{ch.name!r}(depth {ch.depth})"
                           for _, ch in cycle)
         out.append(Finding(
@@ -325,7 +383,8 @@ def extract_channel_specs(path: str) -> Tuple[List["object"], List[str]]:
 
     with open(path, "r", encoding="utf-8") as fh:
         tree = ast.parse(fh.read(), filename=path)
-    fields = ("name", "src", "dst", "depth", "kind", "payload_bytes")
+    fields = ("name", "src", "dst", "depth", "kind", "payload_bytes",
+              "drain", "credits")
     specs: List[object] = []
     notes: List[str] = []
     for node in ast.walk(tree):

@@ -664,6 +664,19 @@ def _is_async_call(node: ast.AST) -> bool:
     if name == "update" and ("zopt" in recv_name or "zeroopt" in recv_name
                              or "zero_opt" in recv_name):
         return True
+    # host-pipeline issuers (tpu_dist/pipeline): a stage's async
+    # activation/gradient put — <stage/pipe>.send_async(...) ALWAYS
+    # returns a PendingSend whose captured channel error (closed, peer
+    # gone, backpressure timeout) surfaces only at wait() — and the
+    # trainer's step handle: <trainer/pipe>.step(...) returns the
+    # StepHandle that applies the optimizer update at wait(); dropping
+    # it silently drops the whole step.  ``.step()`` is common English,
+    # so only receivers that name a trainer/pipeline count.
+    if name == "send_async" and ("stage" in recv_name
+                                 or "pipe" in recv_name):
+        return True
+    if name == "step" and ("trainer" in recv_name or "pipe" in recv_name):
+        return True
     # handle-returning submits: the ordered collective engine
     # (collectives/work.py Engine.submit -> Work) and the serving layer
     # (Scheduler.submit / ServeClient.submit -> RequestHandle, whose

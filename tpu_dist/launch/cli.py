@@ -42,6 +42,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import re
 import signal
 import socket
 import subprocess
@@ -222,7 +223,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "schedule printed), claim-safety, restart-policy "
                         "and placement soundness.  Any error-severity "
                         "finding REFUSES the launch with exit 2 "
-                        "(--roles only)")
+                        "(--roles only).  Pipeline launches (>= 2 "
+                        "stageN roles) run this pre-flight automatically")
+    p.add_argument("--no_verify_graph", "--no-verify-graph",
+                   action="store_true",
+                   help="skip the automatic --verify_graph pre-flight "
+                        "that pipeline launches (>= 2 stageN roles) "
+                        "otherwise get")
     p.add_argument("--standalone", action="store_true",
                    help="single-node mode with automatic rendezvous "
                         "(torchrun parity): forces --nnodes=1 "
@@ -1008,8 +1015,23 @@ def _verify_role_graph(args) -> int:
     src = args.script if (args.script and not args.module
                           and os.path.exists(args.script)) else None
     label = src or "<--roles spec>"
-    graph, findings, notes = build_graph(roles_spec=args.roles, script=src,
-                                         path=label)
+    graph = None
+    findings: list = []
+    notes: list = []
+    if src:
+        # a script exporting a module-level build_graph() (the
+        # examples/pipeline_train.py idiom) hands us the REAL graph —
+        # builder-constructed ChannelSpecs that literal extraction
+        # can't see.  Anything else falls back to extraction.
+        try:
+            graph = build_graph(graph_target=f"{src}:build_graph",
+                                path=label)[0]
+            notes.append(f"graph from {src}:build_graph()")
+        except Exception:
+            graph = None
+    if graph is None:
+        graph, findings, notes = build_graph(roles_spec=args.roles,
+                                             script=src, path=label)
     if graph is not None:
         findings = list(findings) + verify_graph(graph, nnodes=args.nnodes,
                                                  path=label)
@@ -1053,7 +1075,12 @@ def _run_role_graph(args) -> int:
     except RoleGraphError as e:
         sys.stderr.write(f"--roles: {e}\n")
         return 2
-    if args.verify_graph:
+    # pipeline launches (>= 2 stageN roles) get the pre-flight
+    # automatically: a mis-depthed act/grad ring deadlocks every stage,
+    # so refusing before spawn with a witness beats hanging after
+    pipelined = sum(1 for r in graph.roles
+                    if re.fullmatch(r"stage\d+", r.name)) >= 2
+    if args.verify_graph or (pipelined and not args.no_verify_graph):
         rc = _verify_role_graph(args)
         if rc:
             return rc

@@ -30,14 +30,16 @@ __all__ = ["read_dumps", "merge_trace", "diagnose", "render_diagnosis"]
 
 # trace lane per event kind (tid within each rank's track)
 _TID = {"collective": 0, "p2p": 1, "transport": 2, "store": 3, "beat": 4,
-        "channel": 5, "plan": 6}
+        "channel": 5, "plan": 6, "pipeline": 7}
 _TID_NAMES = {0: "collectives", 1: "p2p", 2: "transport", 3: "store",
-              4: "beats", 5: "channels", 6: "plans", 7: "other"}
-_OTHER_TID = 7
+              4: "beats", 5: "channels", 6: "plans", 7: "pipeline",
+              8: "other"}
+_OTHER_TID = 8
 _ARG_KEYS = ("seq", "coll", "outcome", "site", "path", "bytes",
              "wire_bytes", "raw_wire_bytes", "comm", "digest", "reduce",
              "src", "dst", "peer", "key", "step", "detail",
-             "channel", "slot", "plan", "plan_seq", "req", "group")
+             "channel", "slot", "plan", "plan_seq", "req", "group",
+             "stage", "mb", "phase", "stash_bytes")
 
 
 def read_dumps(path, generation: Optional[int] = None) -> List[dict]:
@@ -193,6 +195,24 @@ def diagnose(dumps: List[dict]) -> dict:
                     "old": e.get("old"),
                     "epoch": e.get("epoch")})
     out["store_failovers"] = store_failovers
+    # pipeline stages: a PENDING kind="pipeline" span is a stage blocked
+    # claiming a microbatch (op "claim-act"/"claim-grad") — the starved
+    # stage a dead neighbor leaves behind.  A SIGKILLed stage rank leaves
+    # no dump; its survivors' pending claims name it by adjacency.
+    pipeline_stalls = []
+    for dmp in dumps:
+        role = (f"{dmp['role']}[{dmp.get('role_rank')}]"
+                if dmp.get("role") else None)
+        stall = None
+        for e in dmp.get("events", []):
+            if e.get("kind") == "pipeline" and e.get("outcome") == "pending":
+                stall = e
+        if stall is not None:
+            pipeline_stalls.append({
+                "rank": dmp.get("rank", 0), "role": role,
+                "stage": stall.get("stage"), "mb": stall.get("mb"),
+                "phase": stall.get("phase"), "op": stall.get("op")})
+    out["pipeline_stalls"] = pipeline_stalls
     stuck_ref = ranks[waiting[0]] if waiting else None
     if front < 0:
         out.update({"verdict": "no-collectives", "straggler": None})
@@ -286,6 +306,21 @@ def render_diagnosis(d: dict) -> str:
                if sr.get("prompt_len") is not None else "")
             + ") never completed"
             + (f" — submitted at {sr['site']}" if sr.get("site") else ""))
+    for ps in d.get("pipeline_stalls", []):
+        who = (f"rank {ps['rank']} ({ps['role']})" if ps.get("role")
+               else f"rank {ps['rank']}")
+        what = ("activations" if ps.get("op") == "claim-act"
+                else "gradients" if ps.get("op") == "claim-grad"
+                else ps.get("op"))
+        neighbor = (f"stage{ps['stage'] - 1}" if ps.get("op") == "claim-act"
+                    and ps.get("stage") is not None
+                    else f"stage{ps['stage'] + 1}"
+                    if ps.get("op") == "claim-grad"
+                    and ps.get("stage") is not None else "its neighbor")
+        lines.append(
+            f"  stalled pipeline stage: {who} starved at stage "
+            f"{ps.get('stage')} {ps.get('phase')} mb {ps.get('mb')} — "
+            f"blocked claiming {what} that {neighbor} never produced")
     failovers = d.get("store_failovers") or []
     if failovers:
         latest = max(failovers, key=lambda f: f.get("epoch") or 0)

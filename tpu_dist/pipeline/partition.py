@@ -1,0 +1,216 @@
+"""Layer-span partitioning of model param trees for the host pipeline.
+
+Splits a model into ``num_stages`` contiguous layer spans and exposes,
+per stage, (a) the subset of the plain ``model.init()`` param tree the
+stage owns — keys unchanged, so per-stage checkpoints re-merge into the
+single-process layout bit-for-bit — and (b) a pure ``fn(stage_params,
+x) -> h`` forward over exactly those layers, built from the model's own
+module objects so the math is identical to the full ``model.apply``
+(and to the compiled mesh twin in ``parallel/pipeline.py``, which packs
+the same block spans onto a stacked stage axis).
+
+Supported models:
+
+- :class:`~tpu_dist.models.TransformerLM` — stage 0 owns the embeddings
+  (``tok`` / ``pos``) plus the first block span, the last stage owns the
+  final span plus ``ln_f`` / ``head``.  Spans are contiguous and
+  balanced; when ``depth % num_stages == 0`` they coincide exactly with
+  ``PipelineParallel``'s ``blocks_per_stage`` layout (the mesh-parity
+  requirement).
+- :class:`~tpu_dist.models.ConvNet` — four sequential units
+  (conv+pool x3, flatten+fc), partitionable into up to four stages.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Sequence, Tuple
+
+__all__ = ["ModelPartition", "TransformerPartition", "ConvNetPartition",
+           "partition_model", "PipelinePartitionError"]
+
+
+class PipelinePartitionError(ValueError):
+    """Unsupported model / stage count for layer-span partitioning."""
+
+
+def _spans(num_units: int, num_stages: int) -> List[Tuple[int, int]]:
+    """Balanced contiguous split of ``num_units`` into ``num_stages``
+    non-empty ``[lo, hi)`` ranges (earlier stages take the remainder)."""
+    if num_stages > num_units:
+        raise PipelinePartitionError(
+            f"cannot split {num_units} layer unit(s) into {num_stages} "
+            f"stages — every stage needs at least one")
+    base, rem = divmod(num_units, num_stages)
+    spans, lo = [], 0
+    for i in range(num_stages):
+        hi = lo + base + (1 if i < rem else 0)
+        spans.append((lo, hi))
+        lo = hi
+    return spans
+
+
+def _reroot(stage_params: Dict, prefix: str) -> Dict:
+    """Subset of ``stage_params`` under dotted ``prefix``, re-keyed
+    relative to it (the layout ``module.apply`` expects when ``module``
+    is applied as a root)."""
+    out = {}
+    dotted = prefix + "."
+    for k, v in stage_params.items():
+        if k == prefix:
+            out[""] = v
+        elif k.startswith(dotted):
+            out[k[len(dotted):]] = v
+    return out
+
+
+class ModelPartition:
+    """Base: unit spans + param-key ownership + per-stage forward."""
+
+    def __init__(self, model, num_stages: int, num_units: int):
+        self.model = model
+        self.num_stages = num_stages
+        self.spans = _spans(num_units, num_stages)
+
+    def is_first(self, stage: int) -> bool:
+        return stage == 0
+
+    def is_last(self, stage: int) -> bool:
+        return stage == self.num_stages - 1
+
+    def owner_of(self, key: str) -> int:
+        """Which stage owns param-tree key ``key``."""
+        raise NotImplementedError
+
+    def stage_params(self, params: Dict, stage: int) -> Dict:
+        """The subset of the plain param tree stage ``stage`` owns —
+        original keys, so subsets from all stages merge back into the
+        single-process tree unchanged."""
+        return {k: v for k, v in params.items()
+                if self.owner_of(k) == stage}
+
+    def merge_params(self, parts: Sequence[Dict]) -> Dict:
+        """Inverse of :meth:`stage_params` over all stages' subsets."""
+        out: Dict = {}
+        for p in parts:
+            out.update(p)
+        return out
+
+    def stage_fn(self, stage: int) -> Callable:
+        """Pure ``fn(stage_params, x) -> h`` over the stage's span (jit
+        it once per stage; modules hold topology only)."""
+        raise NotImplementedError
+
+
+class TransformerPartition(ModelPartition):
+    """Block spans over a TransformerLM; embeddings ride stage 0, the
+    head rides the last stage."""
+
+    def __init__(self, model, num_stages: int):
+        depth = getattr(model, "depth", None)
+        if depth is None or not hasattr(model, "block0") \
+                or not hasattr(model, "tok"):
+            raise PipelinePartitionError(
+                f"{type(model).__name__} is not a TransformerLM-shaped "
+                f"model (expects tok/block{{i}}/ln_f/head)")
+        super().__init__(model, num_stages, depth)
+        # the mesh twin's _Embed/_Head wrappers: identical forward math,
+        # and param subtrees keyed exactly as in the plain layout
+        from ..parallel.pipeline import _Embed, _Head
+        self._embed = _Embed(model.tok, model.pos)
+        self._head = _Head(model.ln_f, model.head)
+
+    def owner_of(self, key: str) -> int:
+        head = key.split(".", 1)[0]
+        if head in ("tok", "pos"):
+            return 0
+        if head in ("ln_f", "head"):
+            return self.num_stages - 1
+        if head.startswith("block") and head[len("block"):].isdigit():
+            j = int(head[len("block"):])
+            for i, (lo, hi) in enumerate(self.spans):
+                if lo <= j < hi:
+                    return i
+        raise PipelinePartitionError(
+            f"param key {key!r} does not belong to any stage span")
+
+    def stage_fn(self, stage: int) -> Callable:
+        lo, hi = self.spans[stage]
+        blocks = [getattr(self.model, f"block{j}") for j in range(lo, hi)]
+        prefixes = [f"block{j}" for j in range(lo, hi)]
+        first, last = self.is_first(stage), self.is_last(stage)
+        embed, head = self._embed, self._head
+
+        def fn(stage_params, x):
+            if first:
+                ep = {"tok": stage_params["tok"]}
+                if "pos" in stage_params:
+                    ep["pos"] = stage_params["pos"]
+                x = embed.apply(ep, x)
+            for block, pfx in zip(blocks, prefixes):
+                x = block.apply(_reroot(stage_params, pfx), x)
+            if last:
+                x = head.apply({"ln_f": stage_params["ln_f"],
+                                "head": stage_params["head"]}, x)
+            return x
+
+        return fn
+
+
+class ConvNetPartition(ModelPartition):
+    """The reference ConvNet as four sequential units:
+    ``conv1+pool1``, ``conv2+pool2``, ``conv3+pool3``, ``flatten+fc1``."""
+
+    _UNITS = (("conv1", "maxpool1"), ("conv2", "maxpool2"),
+              ("conv3", "maxpool3"), ("fc1",))
+
+    def __init__(self, model, num_stages: int):
+        for names in self._UNITS:
+            for n in names:
+                if not hasattr(model, n):
+                    raise PipelinePartitionError(
+                        f"{type(model).__name__} is not a ConvNet-shaped "
+                        f"model (missing {n!r})")
+        super().__init__(model, num_stages, len(self._UNITS))
+
+    def owner_of(self, key: str) -> int:
+        head = key.split(".", 1)[0]
+        for u, names in enumerate(self._UNITS):
+            if head in names:
+                for i, (lo, hi) in enumerate(self.spans):
+                    if lo <= u < hi:
+                        return i
+        if head == "dropout":  # defined-but-unused in the reference net
+            return self.num_stages - 1
+        raise PipelinePartitionError(
+            f"param key {key!r} does not belong to any stage span")
+
+    def stage_fn(self, stage: int) -> Callable:
+        lo, hi = self.spans[stage]
+        model = self.model
+
+        def fn(stage_params, x):
+            for u in range(lo, hi):
+                if u < 3:
+                    conv = getattr(model, f"conv{u + 1}")
+                    pool = getattr(model, f"maxpool{u + 1}")
+                    x = conv.apply(_reroot(stage_params, f"conv{u + 1}"), x)
+                    x = pool.apply({}, model.relu.apply({}, x))
+                else:
+                    x = x.reshape(x.shape[0], -1)
+                    x = model.fc1.apply(_reroot(stage_params, "fc1"), x)
+            return x
+
+        return fn
+
+
+def partition_model(model, num_stages: int) -> ModelPartition:
+    """Dispatch on model shape: TransformerLM block spans or ConvNet
+    units."""
+    if hasattr(model, "block0") and hasattr(model, "tok"):
+        return TransformerPartition(model, num_stages)
+    if hasattr(model, "conv1") and hasattr(model, "fc1"):
+        return ConvNetPartition(model, num_stages)
+    raise PipelinePartitionError(
+        f"no layer-span partitioner for {type(model).__name__}: supported "
+        f"shapes are TransformerLM (tok/block{{i}}/ln_f/head) and ConvNet "
+        f"(conv1..3/fc1)")

@@ -145,6 +145,14 @@ class ChannelSpec:
       role's own puts (e.g. the disagg decode leader's KV receive
       loop).  Dedicated-drain edges cannot be the blocked link of a
       bounded-channel wait-for cycle, so TD101 excludes them.
+    - ``credits`` — claim-discipline bound: the producer role promises
+      to keep at most ``credits`` messages unacknowledged in flight on
+      this edge (it interleaves puts with claims of its own inbound
+      edges, the 1F1B pipeline shape).  A cycle in which *every* edge
+      carries a credits annotation with ``depth >= credits`` cannot
+      deadlock — no put ever reaches the backpressure wall — so TD101
+      admits it; an annotated edge with ``depth < credits`` is a
+      deadlock finding with a credit-overflow witness.
     """
     name: str
     src: str
@@ -153,6 +161,7 @@ class ChannelSpec:
     kind: str = "queue"
     payload_bytes: Optional[int] = None
     drain: str = "inline"
+    credits: Optional[int] = None
 
     def __post_init__(self):
         _check_name("channel", self.name)
@@ -174,6 +183,11 @@ class ChannelSpec:
             raise RoleGraphError(
                 f"channel {self.name!r}: payload_bytes "
                 f"{self.payload_bytes!r} must be a positive byte count")
+        if self.credits is not None and (
+                not isinstance(self.credits, int) or self.credits <= 0):
+            raise RoleGraphError(
+                f"channel {self.name!r}: credits {self.credits!r} must be "
+                f"a positive in-flight message bound")
 
 
 class RoleGraph:
