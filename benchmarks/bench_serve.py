@@ -82,14 +82,16 @@ def _workload(n: int, seed: int = 0, smoke: bool = False):
     return reqs
 
 
-def _offline_refs(model, params, reqs):
-    """Ground truth per request: offline greedy ``generate()``."""
+def _offline_refs(model, params, reqs, cache_dtype=None):
+    """Ground truth per request: offline greedy ``generate()`` (with
+    ``cache_dtype`` the int8-slot-cache parity reference)."""
     import jax.numpy as jnp
     import numpy as np
 
     refs = []
     for prompt, gen in reqs:
-        out = model.generate(params, jnp.asarray(prompt)[None, :], gen)
+        out = model.generate(params, jnp.asarray(prompt)[None, :], gen,
+                             cache_dtype=cache_dtype)
         refs.append(np.asarray(out)[0, len(prompt):].tolist())
     return refs
 
@@ -736,7 +738,7 @@ class _DisaggRig:
 
     def __init__(self, model, params, max_len: int, slots: int,
                  prefix=None, step_hook=None, batch_window: float = 0.002,
-                 n_prefill: int = 1):
+                 n_prefill: int = 1, cache_dtype=None, wire=None):
         from tpu_dist import serve
         from tpu_dist.dist.store import TCPStore
         from tpu_dist.collectives.transport import DataPlane
@@ -758,17 +760,20 @@ class _DisaggRig:
             self._chans.append(ch)
             return ch
 
-        template = serve.kv_template(model.init_slot_cache(1, max_len))
+        import jax.numpy as jnp
+        template = serve.kv_template(model.init_slot_cache(
+            1, max_len, cache_dtype or jnp.float32))
         decode_rank = n_prefill
         self.workers = []
         self._stops = []
         self._threads = []
         for r in range(n_prefill):
             w = serve.PrefillWorker(
-                model, params, serve.KVTransfer(self.dps[r], template),
+                model, params,
+                serve.KVTransfer(self.dps[r], template, wire=wire),
                 claim_ch=chan(serve.PREFILL_QUEUE, r),
                 env_chans={0: chan(serve.kv_channel(0), r)},
-                rank=r, max_len=max_len, prefix=prefix)
+                rank=r, max_len=max_len, dtype=cache_dtype, prefix=prefix)
             st = threading.Event()
             self.workers.append(w)
             self._stops.append(st)
@@ -777,11 +782,11 @@ class _DisaggRig:
                 name=f"bench-prefill-{r}"))
         self.engine = serve.DisaggSlotEngine(
             model, params, serve.KVTransfer(self.dps[decode_rank],
-                                            template),
+                                            template, wire=wire),
             dispatch_ch=chan(serve.PREFILL_QUEUE, decode_rank),
             arrive_ch=chan(serve.kv_channel(0), decode_rank),
-            num_slots=slots, max_len=max_len, rank=decode_rank,
-            role_rank=0)
+            num_slots=slots, max_len=max_len, cache_dtype=cache_dtype,
+            rank=decode_rank, role_rank=0)
         self.sched = serve.DisaggScheduler(self.engine,
                                            batch_window=batch_window,
                                            step_hook=step_hook)

@@ -128,10 +128,11 @@ def _better(new: dict, old: dict) -> dict:
 
 def main() -> None:
     sys.path.insert(0, _REPO)
-    from benchmarks import (attention, bench_pipeline, bench_roles,
-                            bench_serve, generate, imagenet_e2e,
-                            input_pipeline, moe_lm, resnet_cifar, scaling,
-                            transformer_lm, vit_train)
+    from benchmarks import (attention, bench_mesh_rules, bench_pipeline,
+                            bench_roles, bench_serve, generate,
+                            imagenet_e2e, input_pipeline, moe_lm,
+                            resnet_cifar, scaling, transformer_lm,
+                            vit_train)
 
     out = os.path.join(_REPO, "BENCH_EXTENDED.json")
     previous = {}
@@ -165,6 +166,7 @@ def main() -> None:
         "serve_disagg": "serve_disagg_tokens_per_sec",
         "roles": "roles_channel_dp_best_mb_s",
         "pipeline": "pipeline_host_tokens_per_sec",
+        "mesh_rules": "mesh_rules_dp_tp_wire_reduction_world4",
     }
     import bench  # repo-root headline (MNIST ConvNet) — ratchet a copy here
     results = []
@@ -190,7 +192,8 @@ def main() -> None:
                      ("serve_sharded", bench_serve.run_sharded),
                      ("serve_disagg", bench_serve.run_disagg),
                      ("roles", bench_roles.run),
-                     ("pipeline", bench_pipeline.run)):
+                     ("pipeline", bench_pipeline.run),
+                     ("mesh_rules", bench_mesh_rules.run)):
         try:
             r = fn()
         except Exception as e:  # record the failure, keep the rest running

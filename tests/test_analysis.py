@@ -475,6 +475,27 @@ ch = Channel(spec, store, 0, "learner", src_span=[1], dst_span=[0])
 ch2 = Channel(spec, store, 1, role, src_span=[1], dst_span=[0])
 """
 
+# hand-rolled parameter-layout PartitionSpec outside the rule plane: a
+# 'model'-axis literal belongs to parallel/rules.py's tables (TD011);
+# batch/stage specs over 'data'/'pipe'/variable axes stay free-form
+TD011_POS = """
+from jax.sharding import PartitionSpec as P
+
+RULES = [
+    (r"qkv_weight", P(None, "model")),
+]
+"""
+
+TD011_NEG = """
+from jax.sharding import PartitionSpec as P
+from tpu_dist.parallel.rules import partition_pairs, spec_for
+
+RULES = partition_pairs()                    # derived: the rule plane
+batch_spec = P("data")                       # batch placement: not layout
+stage_spec = P(axis) if stacked else P()     # variable axis: not provable
+qkv = spec_for("block0.attn", "qkv_weight")  # the sanctioned spelling
+"""
+
 
 class TestRules:
     @pytest.mark.parametrize("rule,pos,neg", [
@@ -489,6 +510,7 @@ class TestRules:
         ("TD008", TD008_POS, TD008_NEG),
         ("TD009", TD009_POS, TD009_NEG),
         ("TD010", TD010_POS, TD010_NEG),
+        ("TD011", TD011_POS, TD011_NEG),
     ])
     def test_positive_flags_negative_passes(self, rule, pos, neg):
         assert rule in _rules(lint_source(pos, f"{rule}_pos.py")), \
@@ -570,10 +592,22 @@ class TestRules:
         assert "lerner" in found[0].message
         assert _rules(lint_source(TD010_CHANNEL_ROLE_NEG, "t.py")) == []
 
+    def test_td011_allowlisted_core_passes(self):
+        # the rule plane and its spec builders ARE the defining sites
+        for allowed in ("tpu_dist/parallel/rules.py",
+                        "tpu_dist/parallel/gspmd.py",
+                        "tpu_dist/parallel/fsdp.py"):
+            assert _rules(lint_source(TD011_POS, allowed)) == [], allowed
+
+    def test_td011_names_the_axis_and_remedy(self):
+        (f,) = lint_source(TD011_POS, "t.py")
+        assert f.severity == "error"
+        assert "'model'" in f.message and "spec_for" in f.message
+
     def test_rule_docs_cover_all_codes(self):
         assert sorted(RULE_DOCS) == ["TD001", "TD002", "TD003", "TD004",
                                      "TD005", "TD006", "TD007", "TD008",
-                                     "TD009", "TD010"]
+                                     "TD009", "TD010", "TD011"]
 
     def test_td008_unguarded_group_collective_warns(self):
         found = lint_source(TD008_UNGUARDED_POS, "t.py")

@@ -67,6 +67,19 @@ def _mk_rows(T, layers=2, heads=2, hd=4, seed=0):
         for j in range(layers)}
 
 
+def _mk_int8_rows(T, layers=2, heads=2, hd=4, seed=0):
+    """The int8-slot-cache row shape: already-quantized int8 k/v with
+    float32 per-(token, head) scales riding as sibling keys — exactly
+    what ``prefill_rows(..., dtype=jnp.int8)`` produces."""
+    rng = np.random.default_rng(seed)
+    return {f"blocks/{j}": {
+        "k": rng.integers(-127, 128, (1, T, heads, hd)).astype(np.int8),
+        "v": rng.integers(-127, 128, (1, T, heads, hd)).astype(np.int8),
+        "k_scale": rng.random((1, T, heads)).astype(np.float32),
+        "v_scale": rng.random((1, T, heads)).astype(np.float32)}
+        for j in range(layers)}
+
+
 # ---------------------------------------------------------------------------
 # KV transfer wire
 # ---------------------------------------------------------------------------
@@ -130,6 +143,64 @@ class TestKVTransfer:
             exact = sum(r[k][:, :16].nbytes for r in rows.values()
                         for k in r)
             assert kv1.fetched_bytes < exact / 2
+        finally:
+            dp0.close(), dp1.close()
+
+    def test_int8_cache_rows_exact_on_quant_wire(self, store):
+        # an int8 SLOT cache's rows on the lossy wire: the int8 k/v
+        # fragments are ALREADY quantized and ship bit-exact
+        # (re-quantizing integer data would be pure loss); only their
+        # float scale fragments ride the int8_block wire
+        from tpu_dist.collectives.transport import DataPlane
+        dp0, dp1 = DataPlane(store, 0, 2), DataPlane(store, 1, 2)
+        template = kv_template(_mk_int8_rows(8))
+        kv0 = KVTransfer(dp0, template, wire="int8_block32")
+        kv1 = KVTransfer(dp1, template, wire="int8_block32")
+        try:
+            rows = _mk_int8_rows(16, seed=11)
+            err = []
+
+            def send():
+                try:
+                    kv0.send(1, 21, rows, 16, 3)
+                except Exception as e:
+                    err.append(e)
+            t = threading.Thread(target=send)
+            t.start()
+            got = kv1.fetch(0, 21, 30.0)
+            t.join(30)
+            assert not err, err
+            for path in rows:
+                for k in ("k", "v"):
+                    a = got["rows"][path][k]
+                    assert a.dtype == np.int8
+                    np.testing.assert_array_equal(a, rows[path][k])
+                for k in ("k_scale", "v_scale"):
+                    a, b = got["rows"][path][k], rows[path][k]
+                    assert a.dtype == np.float32
+                    assert np.max(np.abs(a - b)) < 0.1
+                    assert not np.array_equal(a, b)   # the lossy opt-in
+        finally:
+            dp0.close(), dp1.close()
+
+    def test_int8_cache_rows_round_trip_exact_wire(self, store):
+        # and on the default exact wire the whole mixed tree — int8
+        # k/v AND f32 scales — round-trips bitwise
+        from tpu_dist.collectives.transport import DataPlane
+        dp0, dp1 = DataPlane(store, 0, 2), DataPlane(store, 1, 2)
+        template = kv_template(_mk_int8_rows(8))
+        kv0, kv1 = KVTransfer(dp0, template), KVTransfer(dp1, template)
+        try:
+            rows = _mk_int8_rows(12, seed=13)
+            t = threading.Thread(
+                target=lambda: kv0.send(1, 23, rows, 10, 5))
+            t.start()
+            got = kv1.fetch(0, 23, 30.0)
+            t.join(30)
+            for path in rows:
+                for k in ("k", "v", "k_scale", "v_scale"):
+                    np.testing.assert_array_equal(
+                        got["rows"][path][k], rows[path][k][:, :10])
         finally:
             dp0.close(), dp1.close()
 
@@ -344,14 +415,27 @@ class TestDisaggEngine:
         finally:
             eng.close()
 
-    def test_int8_slot_cache_rejected_by_name(self, lm):
+    def test_int8_slot_cache_pool_carries_scales(self, lm):
+        # the int8 slot cache is a first-class disagg citizen: the
+        # engine builds, its pool holds int8 k/v plus the f32
+        # per-(token, head) scales, and kv_template lists every
+        # fragment so the scales travel like ordinary rows
         model, params = lm
-        with pytest.raises(DisaggError, match="int8 slot"):
-            DisaggSlotEngine(model, params,
-                             kv=SimpleNamespace(fetched_bytes=0),
-                             dispatch_ch=_StubDispatch(),
-                             arrive_ch=_StubArrive(),
-                             cache_dtype=jnp.int8, rank=1)
+        eng = DisaggSlotEngine(model, params,
+                               kv=SimpleNamespace(fetched_bytes=0),
+                               dispatch_ch=_StubDispatch(),
+                               arrive_ch=_StubArrive(),
+                               num_slots=2, max_len=64,
+                               cache_dtype=jnp.int8, rank=1)
+        try:
+            entry = next(iter(eng.cache.values()))
+            assert entry["k"].dtype == jnp.int8
+            assert entry["k_scale"].dtype == jnp.float32
+            tpl = kv_template(model.init_slot_cache(1, 64, jnp.int8))
+            assert set(next(iter(tpl.values()))) == {
+                "k", "v", "k_scale", "v_scale"}
+        finally:
+            eng.close()
 
     def test_disagg_graph_shape(self):
         g = serve.disagg_graph(2, 3)
@@ -442,6 +526,35 @@ def test_bench_serve_disagg_smoke():
     assert row["tokens_ok"] is True
     assert row["transfers"] == row["requests"] == 5
     assert row["prefix_hits"] >= 2
+
+
+def test_int8_disagg_parity_vs_offline_generate(lm):
+    """int8 slot cache end-to-end through the disaggregated stack:
+    greedy tokens with ``cache_dtype=int8`` — prefill forward, quantized
+    rows + scales over the KV wire, slot scatter, quantized decode — are
+    token-identical to offline ``generate(cache_dtype=int8)``, which
+    runs the same per-(token, head) quantized-cache math in one
+    process."""
+    sys.path.insert(0, _REPO)
+    from benchmarks import bench_serve
+    model, params = lm
+    rig = bench_serve._DisaggRig(model, params, max_len=64, slots=2,
+                                 cache_dtype=jnp.int8)
+    try:
+        reqs = [(np.arange(2, 10, dtype=np.int32), 5),
+                (np.arange(11, 31, dtype=np.int32), 4)]
+        refs = bench_serve._offline_refs(model, params, reqs,
+                                         cache_dtype=jnp.int8)
+        for i, (p, g) in enumerate(reqs):
+            out = rig.sched.submit(
+                p, max_new_tokens=g,
+                timeout=60.0).wait_done(timeout=600.0)
+            assert out == refs[i], (
+                f"int8 disagg request {i} diverged from offline int8 "
+                f"generate(): {out} vs {refs[i]}")
+        assert rig.engine.stats()["kv"]["transfers"] == len(reqs)
+    finally:
+        rig.close()
 
 
 # ---------------------------------------------------------------------------

@@ -47,6 +47,14 @@ deadlocking example per rule):
   absent from the module's ``RoleGraph`` literal (error — a dangling
   endpoint raises ``RoleGraphError`` at runtime and can never carry a
   message).
+- **TD011** — hand-rolled ``PartitionSpec`` naming a rule-plane layout
+  axis (``model``/``shard``/``expert``) outside ``parallel/rules.py``
+  and its spec builders (``gspmd.py``, ``fsdp.py``): parameter
+  placements derive from the unified logical-axis table
+  (``rules.spec_for``/``partition_pairs``/``spans_for``) — duplicated
+  layout literals are exactly how the pjit, ZeRO, reshard and serving
+  layouts drifted before the rule plane existed.
+
 - **TD007** — async collective ``Work`` handle dropped without ``wait()``:
   a bare-expression call with ``async_op=True`` (the handle is discarded
   on the spot), or a handle assigned to a name that is never used again.
@@ -1070,6 +1078,82 @@ def rule_td010(tree: ast.AST, path: str) -> List[Finding]:
     return out
 
 
+# -- TD011: hand-rolled parameter-layout PartitionSpecs -----------------------
+
+# mesh axes the unified rule plane (tpu_dist/parallel/rules.py) owns:
+# parameter placements over these derive from the logical-axis rule +
+# layout tables.  'data'/'pipe'/shard_map batch specs are NOT layout
+# arithmetic and stay free-form.
+_TD011_LAYOUT_AXES = frozenset({"model", "shard", "expert"})
+
+# the rule plane itself plus the spec builders that DEFINE the generated
+# tables — the only modules allowed to spell layout axes into
+# PartitionSpec literals by hand
+_TD011_ALLOWED_SUFFIXES = (
+    "parallel/rules.py", "parallel/gspmd.py", "parallel/fsdp.py",
+)
+
+
+def _spec_constructor_names(tree: ast.AST) -> frozenset:
+    """Local names bound to ``jax.sharding.PartitionSpec`` by import —
+    including the conventional ``as P`` alias."""
+    names = {"PartitionSpec"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.name == "PartitionSpec":
+                    names.add(alias.asname or alias.name)
+    return frozenset(names)
+
+
+def _layout_axis_literal(call: ast.Call) -> Optional[str]:
+    """The first string-literal argument naming a rule-plane layout axis,
+    looking through tuple entries (``P(("data", "model"))``)."""
+    def scan(node):
+        if isinstance(node, ast.Constant) and node.value in \
+                _TD011_LAYOUT_AXES:
+            return node.value
+        if isinstance(node, ast.Tuple):
+            for elt in node.elts:
+                hit = scan(elt)
+                if hit is not None:
+                    return hit
+        return None
+
+    for arg in list(call.args) + [kw.value for kw in call.keywords]:
+        hit = scan(arg)
+        if hit is not None:
+            return hit
+    return None
+
+
+def rule_td011(tree: ast.AST, path: str) -> List[Finding]:
+    norm = path.replace("\\", "/")
+    if norm.endswith(_TD011_ALLOWED_SUFFIXES):
+        return []
+    spec_names = _spec_constructor_names(tree)
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _terminal_name(node.func) not in spec_names:
+            continue
+        axis = _layout_axis_literal(node)
+        if axis is None:
+            continue
+        out.append(Finding(
+            "TD011", "error", path, node.lineno, node.col_offset,
+            f"hand-rolled PartitionSpec places rule-plane mesh axis "
+            f"{axis!r} outside tpu_dist/parallel/rules.py: derive the "
+            f"placement from the logical-axis table instead "
+            f"(rules.spec_for / partition_pairs for pjit specs, "
+            f"rules.spans_for for host-path spans) — duplicated layout "
+            f"literals are how the pjit, ZeRO, reshard and serving "
+            f"layouts drift apart"))
+    out.sort(key=lambda f: (f.line, f.col))
+    return out
+
+
 # -- registry -----------------------------------------------------------------
 
 RULES = {
@@ -1082,6 +1166,7 @@ RULES = {
     "TD008": rule_td008,
     "TD009": rule_td009,
     "TD010": rule_td010,
+    "TD011": rule_td011,
 }
 
 RULE_DOCS = {
@@ -1105,6 +1190,10 @@ RULE_DOCS = {
              "put/get/get_latest (warning, TD004 family), or a "
              "Channel/ChannelSpec endpoint naming a role absent from "
              "the module's RoleGraph literal (error)",
+    "TD011": "hand-rolled PartitionSpec over a rule-plane layout axis "
+             "('model'/'shard'/'expert') outside parallel/rules.py and "
+             "its spec builders (gspmd.py, fsdp.py) — parameter "
+             "placements must derive from the logical-axis rule table",
 }
 
 

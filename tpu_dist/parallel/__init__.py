@@ -9,8 +9,16 @@ from .ddp import (DistributedDataParallel, TrainState,
 from .fsdp import fsdp_shard, fsdp_specs
 from .gspmd import (MOE_EP_RULES, PartitionRules, TRANSFORMER_TP_RULES,
                     make_gspmd_train_step, shard_pytree)
+from .mesh import get_mesh, mesh_shape_for
 from .pipeline import PipelineParallel, PipeTrainState
 from .ring_attention import ring_self_attention, ulysses_self_attention
+from .rules import (DEFAULT_RULES, SERVING_RULES, LeafLayout,
+                    ShardLayoutError, TRANSFORMER_LAYOUTS, chunk_bounds,
+                    chunk_span, layout_for, mapped_axes, model_axes,
+                    partition_pairs, shard_leaf, spans_for, spec_for,
+                    spec_for_key)
+from .tensor import (SerialTPRunner, TPConfigError, TPTrainer,
+                     build_tp_stage_fns, tp_shard_params)
 from .zero import ZeroOptimizer, ZeroParams, ZeroStateError
 
 # torch-style alias (the reference imports nn.parallel.DistributedDataParallel)
@@ -22,5 +30,13 @@ __all__ = ["DistributedDataParallel", "DDP", "TrainState",
            "make_gspmd_train_step", "shard_pytree",
            "PipelineParallel", "PipeTrainState",
            "fsdp_shard", "fsdp_specs",
+           "get_mesh", "mesh_shape_for",
+           "DEFAULT_RULES", "SERVING_RULES", "LeafLayout",
+           "ShardLayoutError", "TRANSFORMER_LAYOUTS", "chunk_bounds",
+           "chunk_span", "layout_for", "mapped_axes", "model_axes",
+           "partition_pairs", "shard_leaf", "spans_for", "spec_for",
+           "spec_for_key",
+           "TPTrainer", "SerialTPRunner", "TPConfigError",
+           "tp_shard_params", "build_tp_stage_fns",
            "ring_self_attention", "ulysses_self_attention",
            "ZeroOptimizer", "ZeroParams", "ZeroStateError"]

@@ -53,11 +53,32 @@ def _existing_spec(leaf) -> Optional[P]:
     return None
 
 
-def _leaf_spec(leaf, axis: str, axis_size: int, min_size: int) -> P:
+def _rule_spec_fn(rules):
+    """keystr -> base PartitionSpec (or None) from a rule source: a
+    logical-axis rule table dict (the unified plane, parallel/rules.py),
+    a gspmd.PartitionRules, or None."""
+    if rules is None:
+        return lambda key: None
+    if isinstance(rules, dict):
+        from .rules import spec_for_key
+
+        def from_table(key):
+            spec = spec_for_key(key, rules)
+            return spec if any(a is not None for a in spec) else None
+        return from_table
+    # PartitionRules-shaped: anything answering spec_for(path)
+    def from_rules(key):
+        spec = rules.spec_for(key)
+        return spec if any(a is not None for a in spec) else None
+    return from_rules
+
+
+def _leaf_spec(leaf, axis: str, axis_size: int, min_size: int,
+               base: Optional[P] = None) -> P:
     if leaf is None:
         return P()
     shape = getattr(leaf, "shape", ())
-    existing = _existing_spec(leaf)
+    existing = base if base is not None else _existing_spec(leaf)
     if existing is not None:
         # already placed by another strategy (e.g. TP rules on a
         # ('data','fsdp','model') mesh): keep those axes and ADD the fsdp
@@ -88,27 +109,39 @@ def _leaf_spec(leaf, axis: str, axis_size: int, min_size: int) -> P:
     return P()
 
 
-def fsdp_specs(tree, mesh, axis: str = "data", min_size: int = 2 ** 12):
+def fsdp_specs(tree, mesh, axis: str = "data", min_size: int = 2 ** 12,
+               rules=None):
     """PartitionSpec pytree: each leaf's largest ``axis_size``-divisible
     dim sharded over ``axis``; leaves smaller than ``min_size`` elements
     (or with no divisible dim) replicate.  Leaves already carrying a
     non-trivial sharding (TP/EP placements) keep those axes and gain
     ``axis`` on their largest free divisible dim (2-D weight sharding);
-    if ``axis`` is already placed on the leaf, it is left unchanged."""
+    if ``axis`` is already placed on the leaf, it is left unchanged.
+
+    ``rules``: base placement source applied BEFORE the fsdp axis — a
+    logical-axis rule table dict (parallel/rules.py, the unified plane)
+    or a ``PartitionRules`` — so tp×fsdp hybrids compose from specs
+    alone, without a device_put round-trip to stamp the tp axes."""
     size = mesh.shape[axis]
-    return jax.tree.map(
-        lambda l: _leaf_spec(l, axis, size, min_size), tree,
-        is_leaf=lambda x: x is None)
+    base_of = _rule_spec_fn(rules)
+    is_leaf = lambda x: x is None  # noqa: E731
+    flat = jax.tree_util.tree_leaves_with_path(tree, is_leaf=is_leaf)
+    specs = [_leaf_spec(l, axis, size, min_size,
+                        base=base_of(jax.tree_util.keystr(p)))
+             for p, l in flat]
+    treedef = jax.tree_util.tree_structure(tree, is_leaf=is_leaf)
+    return jax.tree_util.tree_unflatten(treedef, specs)
 
 
 def fsdp_shard(tree, mesh, axis: str = "data",
                min_size: int = 2 ** 12,
-               specs: Optional[object] = None):
+               specs: Optional[object] = None,
+               rules=None):
     """``device_put`` every leaf per :func:`fsdp_specs` (or explicit
     ``specs``).  Apply to params AND optimizer state — the committed
     shardings then steer the jitted step into the ZeRO-3 schedule."""
     if specs is None:
-        specs = fsdp_specs(tree, mesh, axis, min_size)
+        specs = fsdp_specs(tree, mesh, axis, min_size, rules=rules)
     return jax.tree.map(
         lambda l, s: (None if l is None
                       else jax.device_put(l, NamedSharding(mesh, s))),

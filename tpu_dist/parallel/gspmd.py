@@ -27,6 +27,8 @@ from typing import Callable, Optional, Sequence, Tuple
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from . import rules as _rules
+
 __all__ = ["PartitionRules", "shard_pytree", "make_gspmd_train_step",
            "TRANSFORMER_TP_RULES", "MOE_EP_RULES"]
 
@@ -61,26 +63,19 @@ class PartitionRules:
 # - attention-out and MLP-out sharded row-wise (input features) — XLA
 #   places the single all-reduce after each row-parallel matmul,
 # - embeddings and LM head sharded on the vocab/feature dimension.
-TRANSFORMER_TP_RULES = PartitionRules([
-    (r"qkv_weight", P(None, "model")),
-    (r"qkv_bias", P("model")),
-    (r"out_weight", P("model", None)),
-    (r"mlp\.0'\]\['weight", P(None, "model")),
-    (r"mlp\.0'\]\['bias", P("model")),
-    (r"mlp\.2'\]\['weight", P("model", None)),
-    (r"\['head'\].*weight", P(None, "model")),
-    (r"\['head'\].*bias", P("model")),
-    (r"\['tok'\].*weight", P("model", None)),
-])
+# Derived from the unified rule plane (parallel/rules.py): the same
+# DEFAULT_RULES + layout table that drives ZeRO shards, reshard
+# manifests, serving spans, and host dp×tp training produces these
+# specs, so the compiled mesh program and the eager host twin cannot
+# drift (golden-pinned to the pre-refactor literals in tests/test_rules).
+TRANSFORMER_TP_RULES = PartitionRules(_rules.partition_pairs())
 
 # Expert parallelism over an 'expert' mesh axis: every stacked MoE leaf
 # (w1/b1/w2/b2, leading dim = num_experts; see nn/moe.py) shards its expert
 # axis; the router and everything else replicate.  The dispatch/combine
 # einsums then partition over 'expert' and XLA inserts the token
 # all-to-alls the GShard paper wires by hand.
-MOE_EP_RULES = PartitionRules([
-    (r"mlp'\]\['[wb][12]'\]", P("expert")),
-])
+MOE_EP_RULES = PartitionRules(_rules.partition_pairs({"expert": "expert"}))
 
 
 def shard_pytree(tree, mesh, rules: Optional[PartitionRules] = None):

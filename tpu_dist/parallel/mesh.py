@@ -1,0 +1,54 @@
+"""Thin mesh builder for the ("data", "model") rule plane.
+
+The rule tables in :mod:`tpu_dist.parallel.rules` name mesh dims; this is
+the one place those names become a ``jax.sharding.Mesh``.  Kept separate
+from rules.py so the layout arithmetic stays importable without jax.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["get_mesh", "mesh_shape_for"]
+
+
+def mesh_shape_for(rules: Dict[str, Optional[str]], world: int,
+                   model_parallel: int = 1,
+                   axis_names: Tuple[str, str] = ("data", "model")
+                   ) -> Dict[str, int]:
+    """dp×mp factorization of ``world`` for a rule binding: the model dim
+    gets ``model_parallel`` only when some logical axis actually rides it
+    (an all-``None`` table collapses to pure dp — editing only the rule
+    table re-partitions the run)."""
+    data_name, model_name = axis_names
+    mp = model_parallel if any(m == model_name for m in rules.values()) \
+        else 1
+    if world % mp:
+        raise ValueError(f"world {world} not divisible by model_parallel "
+                         f"{mp}")
+    return {data_name: world // mp, model_name: mp}
+
+
+def get_mesh(dp: Optional[int] = None, mp: int = 1,
+             axis_names: Sequence[str] = ("data", "model"),
+             devices=None):
+    """``Mesh`` of shape (dp, mp) over ``axis_names``.  ``dp=None`` takes
+    every available device: ``get_mesh(mp=2)`` on 8 devices is a 4×2
+    dp×tp mesh."""
+    import jax
+    from jax.sharding import Mesh
+    if devices is None:
+        devices = jax.devices()
+    if dp is None:
+        if len(devices) % mp:
+            raise ValueError(f"{len(devices)} devices not divisible by "
+                             f"mp={mp}")
+        dp = len(devices) // mp
+    need = dp * mp
+    if len(devices) < need:
+        raise ValueError(f"need {need} devices for a {dp}x{mp} mesh, "
+                         f"have {len(devices)}")
+    arr = np.asarray(devices[:need], dtype=object).reshape(dp, mp)
+    return Mesh(arr, tuple(axis_names))

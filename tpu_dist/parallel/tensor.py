@@ -1,0 +1,859 @@
+"""Host-path tensor parallelism driven by the unified rule table.
+
+The mesh path already does Megatron-style tp by annotation
+(``gspmd.TRANSFORMER_TP_RULES``: XLA cuts the matmuls and inserts the
+all-reduces).  This module is its **eager host twin**: the same
+``parallel/rules.py`` table decides which logical axes shard, the forward
+runs column-parallel (fused qkv / mlp-up / lm-head) and row-parallel
+(attn-out / mlp-down) matmuls per rank, and the partial sums combine over
+``new_group`` sub-groups on the typed data plane.  Because both paths are
+derived from ONE table, changing only the rule table re-partitions the
+compiled program and the host program together — and the host forward is
+verified **bitwise** against rule-driven pjit in
+``benchmarks/bench_mesh_rules.py --smoke`` (veScale's eager-mode-consistent
+SPMD, PAPERS.md).
+
+Layout contract (what makes the twin bitwise):
+
+- every tp rank holds the shard :func:`rules.spans_for` assigns it
+  (``partial="replicate"``: row-parallel output biases replicate);
+- row-parallel matmuls emit **bias-free partials**; the bias is added
+  AFTER the combine — the association XLA's psum+bias takes;
+- partial sums fold in **rank order** on every rank (the serving
+  ``_exchange_all_reduce`` discipline), so all ranks hold identical bytes;
+  at tp=2 the bandwidth-optimal ring produces the same bits (two-operand
+  fp adds commute);
+- per-head attention and per-column projections are exact slices of the
+  full computation, so only the row-parallel reductions reassociate —
+  and those reassociate identically on host and mesh.
+
+Composes three ways: ``dp`` × ``tp`` in :class:`TPTrainer` (tp gangs and
+dp gangs are ``new_group`` sub-groups of one flat world; gradients ride
+the bucketer over the dp gang), ``tp`` inside a pipeline stage via
+:func:`build_tp_stage_fns` (dp×tp×pp), and a threaded in-process oracle
+:class:`SerialTPRunner` for bitwise tests without sockets.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .rules import (DEFAULT_RULES, ShardLayoutError, model_axes, shard_leaf,
+                    spans_for)
+
+__all__ = ["TPConfigError", "LocalCombiner", "PlaneCombiner",
+           "tp_shard_params", "TPTrainer", "SerialTPRunner",
+           "build_tp_stage_fns"]
+
+# direct-exchange / ring crossover for tp partial-sum combines — same
+# constant and rationale as serve/sharded.py: training partials are
+# (B, T, dim) activations, usually above this, but tiny test models and
+# the deferred norm-grad tree sit below it where the exchange's single
+# one-way latency wins
+_EXCHANGE_MAX_BYTES = 128 << 10
+
+#: logical axes the host engine knows how to split (a table binding any
+#: OTHER axis to the tp mesh dim is a config error here, though the pjit
+#: path may well support it)
+_HOST_SHARDABLE = ("heads", "mlp", "vocab")
+
+
+class TPConfigError(ValueError):
+    """The model/table cannot run host tensor-parallel as asked (axis not
+    divisible by tp, unsupported sharded axis, MoE/sequence-parallel
+    model, world not divisible by tp) — named at construction."""
+
+
+def _tp_span(op: str, value, group: str):
+    try:
+        from ..obs.hooks import collective_span
+    except Exception:
+        import contextlib
+        return contextlib.nullcontext()
+    return collective_span(op, value=value, reduce_op="sum", group=group)
+
+
+def _note_algo(algo: str) -> None:
+    try:
+        from ..obs.hooks import note_algo
+        note_algo(algo)
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# combiners: rank-order partial-sum folds (the serving exchange discipline)
+# ---------------------------------------------------------------------------
+
+class _LocalPort:
+    """One rank's handle on a :class:`LocalCombiner`."""
+
+    def __init__(self, combiner: "LocalCombiner", rank: int):
+        self._c = combiner
+        self.rank = int(rank)
+        self.world = combiner.world
+        self.bytes_sent = 0
+
+    def all_reduce(self, arr: np.ndarray) -> np.ndarray:
+        self.bytes_sent += (self.world - 1) * arr.nbytes
+        return self._c._combine(self.rank, arr, "sum")
+
+    def all_gather_last(self, arr: np.ndarray) -> np.ndarray:
+        self.bytes_sent += (self.world - 1) * arr.nbytes
+        return self._c._combine(self.rank, arr, "gather")
+
+    def tree_all_reduce(self, tree: Dict[str, Dict[str, np.ndarray]]):
+        return {p: {k: self.all_reduce(v) for k, v in d.items()}
+                for p, d in tree.items()}
+
+
+class LocalCombiner:
+    """In-process tp gang for the threaded oracle: shared slots + a
+    barrier, rank 0 folds **in rank order** (``acc = s0.copy(); acc =
+    acc + s1; ...`` — exactly the serving exchange fold), every rank
+    reads the same result bytes."""
+
+    def __init__(self, world: int, timeout: float = 120.0):
+        self.world = int(world)
+        self.timeout = float(timeout)
+        self._barrier = threading.Barrier(self.world)
+        self._slots: List[Optional[np.ndarray]] = [None] * self.world
+        self._out: Optional[np.ndarray] = None
+
+    def bound(self, rank: int) -> _LocalPort:
+        return _LocalPort(self, rank)
+
+    def _combine(self, rank: int, arr, how: str) -> np.ndarray:
+        arr = np.asarray(arr)
+        if self.world == 1:
+            return arr.copy()
+        self._slots[rank] = arr
+        self._barrier.wait(timeout=self.timeout)
+        if rank == 0:
+            if how == "sum":
+                acc: Optional[np.ndarray] = None
+                for s in self._slots:
+                    acc = s.copy() if acc is None else acc + s
+                self._out = acc
+            else:
+                self._out = np.concatenate(self._slots, axis=-1)
+        self._barrier.wait(timeout=self.timeout)
+        out = np.array(self._out)
+        # third wait: nobody re-deposits into the slots before every rank
+        # has copied this round's result out
+        self._barrier.wait(timeout=self.timeout)
+        return out
+
+
+def _exchange_sum(dp, arr: np.ndarray, tag: str, timeout: float):
+    """Direct-exchange SUM over a group data plane — fold order is RANK
+    order on every rank (byte-identical everywhere), mirroring
+    serve/sharded.py's ``_exchange_all_reduce``."""
+    flat = np.ascontiguousarray(arr.reshape(-1))
+    for dst in range(dp.num_processes):
+        if dst != dp.rank:
+            dp.send_array(dst, tag, flat)
+    acc = None
+    for src in range(dp.num_processes):
+        part = flat if src == dp.rank else dp.recv_array(src, tag, timeout)
+        acc = part.copy() if acc is None else acc + part
+    return acc.reshape(arr.shape)
+
+
+class PlaneCombiner:
+    """Tp partial-sum combiner over a ``new_group`` sub-group of the data
+    plane.  Small payloads take the latency-optimal direct exchange,
+    large ones the bandwidth-optimal ring; every combine is an obs
+    ``group=`` span stamped with the chosen ``algo=`` so ``obs diagnose``
+    attributes tp traffic to the gang rather than the world's lockstep
+    sequence.  ``bytes_sent`` accumulates this rank's wire bytes (the
+    bench_mesh_rules per-step wire metric)."""
+
+    def __init__(self, group, dp, timeout: float = 120.0):
+        self.group = group
+        self.world = group.num_processes
+        self.rank = group.require_member("tp combine")
+        self._view = group.view(dp) if self.world > 1 else None
+        self.timeout = float(timeout)
+        self.bytes_sent = 0
+        self._seq = 0
+
+    def _tag(self) -> str:
+        self._seq += 1
+        return f"tp{self._seq}"
+
+    def all_reduce(self, arr: np.ndarray) -> np.ndarray:
+        arr = np.ascontiguousarray(np.asarray(arr))
+        if self.world == 1:
+            return arr.copy()
+        gid = f"tp:{self.group.group_id}"
+        with _tp_span("tp_all_reduce", arr, gid):
+            if arr.nbytes <= _EXCHANGE_MAX_BYTES:
+                _note_algo("exchange")
+                out = _exchange_sum(self._view, arr, self._tag(),
+                                    self.timeout)
+                self.bytes_sent += (self.world - 1) * arr.nbytes
+            else:
+                from ..collectives.ring import ring_all_reduce
+                _note_algo("ring")
+                out = ring_all_reduce(self._view, arr, op="sum",
+                                      tag=self._tag())
+                self.bytes_sent += (2 * arr.nbytes
+                                    * (self.world - 1)) // self.world
+        return out
+
+    def all_gather_last(self, arr: np.ndarray) -> np.ndarray:
+        """Concatenate every rank's block along the last axis, in rank
+        order (column-parallel lm-head logits)."""
+        arr = np.ascontiguousarray(np.asarray(arr))
+        if self.world == 1:
+            return arr.copy()
+        gid = f"tp:{self.group.group_id}"
+        with _tp_span("tp_all_gather", arr, gid):
+            _note_algo("exchange")
+            tag = self._tag()
+            flat = arr.reshape(-1)
+            for dst in range(self.world):
+                if dst != self.rank:
+                    self._view.send_array(dst, tag, flat)
+            parts = []
+            for src in range(self.world):
+                p = (flat if src == self.rank
+                     else self._view.recv_array(src, tag, self.timeout))
+                parts.append(p.reshape(arr.shape))
+            self.bytes_sent += (self.world - 1) * arr.nbytes
+        return np.concatenate(parts, axis=-1)
+
+    def tree_all_reduce(self, tree: Dict[str, Dict[str, np.ndarray]]):
+        return {p: {k: self.all_reduce(v) for k, v in d.items()}
+                for p, d in tree.items()}
+
+
+# ---------------------------------------------------------------------------
+# parameter sharding (rule-table driven)
+# ---------------------------------------------------------------------------
+
+def tp_shard_params(model, params, rank: int, world: int, rules=None):
+    """This tp rank's local parameter tree: every leaf sliced per
+    :func:`rules.spans_for` under the table's ``model``-axis bindings
+    (``partial="replicate"``: row-parallel output biases live full on
+    every rank and are added once, post-combine).  Keys are unchanged —
+    merging all ranks' column/row slices reassembles ``model.init()``'s
+    tree exactly."""
+    if rules is None:
+        rules = DEFAULT_RULES
+    axes = model_axes(model)
+    out: Dict[str, Dict[str, np.ndarray]] = {}
+    try:
+        for path, leaves in params.items():
+            d = {}
+            for name, arr in leaves.items():
+                a = np.asarray(arr)
+                plan = spans_for(path, name, a.shape, axes, rank, world,
+                                 rules=rules, mesh_axis="model",
+                                 partial="replicate")
+                d[name] = shard_leaf(a, plan)
+            out[path] = d
+    except ShardLayoutError as e:
+        raise TPConfigError(str(e)) from None
+    return out
+
+
+# ---------------------------------------------------------------------------
+# jitted per-rank segments (shared cache: same shapes -> same executable)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class _SegCfg:
+    norm: str          # "layernorm" | "rmsnorm"
+    block_eps: float
+    final_eps: float
+    heads: int         # LOCAL head count
+    head_dim: int
+    rope: bool
+    rope_theta: float
+    causal: bool
+
+
+_SEG_CACHE: Dict[_SegCfg, Dict[str, Callable]] = {}
+_SEG_MU = threading.Lock()
+
+
+def _norm_fwd(kind: str, eps: float, p, x):
+    # byte-for-byte the op sequence of nn.LayerNorm / nn.RMSNorm.forward
+    import jax
+    import jax.numpy as jnp
+    if kind == "layernorm":
+        mean = x.mean((x.ndim - 1,), keepdims=True)
+        var = ((x - mean) ** 2).mean((x.ndim - 1,), keepdims=True)
+        y = (x - mean) * jax.lax.rsqrt(var + eps)
+        return y * p["weight"] + p["bias"]
+    xf = x.astype(jnp.float32)
+    y = xf * jax.lax.rsqrt(jnp.mean(jnp.square(xf), (x.ndim - 1,),
+                                    keepdims=True) + eps)
+    y = y.astype(x.dtype)
+    return y * p["weight"].astype(x.dtype)
+
+
+def _segments(cfg: _SegCfg) -> Dict[str, Callable]:
+    """The jitted segment set for one engine shape-config.  Cached on the
+    config so every engine (trainer ranks, serial oracle lanes, pipeline
+    stages) with the same local shapes shares ONE compiled executable —
+    which is also what makes their outputs bitwise-identical."""
+    with _SEG_MU:
+        got = _SEG_CACHE.get(cfg)
+        if got is not None:
+            return got
+    import jax
+    import jax.numpy as jnp
+    from ..nn.attention import rotary_embed, scaled_dot_product_attention
+
+    def attn_branch(p, x):
+        h = _norm_fwd(cfg.norm, cfg.block_eps, p["ln"], x)
+        qkv = jnp.dot(h, p["qkv_w"])
+        if "qkv_b" in p:
+            qkv = qkv + p["qkv_b"]
+        b, t = x.shape[0], x.shape[1]
+        qkv = qkv.reshape(b, t, 3, cfg.heads, cfg.head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if cfg.rope:
+            pos = jnp.arange(t)
+            q = rotary_embed(q, pos, cfg.rope_theta)
+            k = rotary_embed(k, pos, cfg.rope_theta)
+        out = scaled_dot_product_attention(q, k, v, causal=cfg.causal,
+                                           impl="dense")
+        out = out.reshape(b, t, cfg.heads * cfg.head_dim)
+        return jnp.dot(out, p["out_w"])  # bias-free partial
+
+    def mlp_branch(p, x):
+        h = _norm_fwd(cfg.norm, cfg.block_eps, p["ln"], x)
+        u = jnp.dot(h, p["w0"])
+        if "b0" in p:
+            u = u + p["b0"]
+        g = jax.nn.gelu(u, approximate=False)
+        return jnp.dot(g, p["w2"])  # bias-free partial
+
+    def head_branch(p, x):
+        f = _norm_fwd(cfg.norm, cfg.final_eps, p["ln"], x)
+        z = jnp.dot(f, p["w"])
+        if "b" in p:
+            z = z + p["b"]
+        return z
+
+    def tok_full(w, idx):
+        return jnp.take(w, idx, axis=0)
+
+    def tok_shard(w, idx, lo):
+        rows = w.shape[0]
+        rel = idx - lo
+        ok = (rel >= 0) & (rel < rows)
+        e = jnp.take(w, jnp.clip(rel, 0, rows - 1), axis=0)
+        return jnp.where(ok[..., None], e, jnp.zeros((), e.dtype))
+
+    def pos_rows(w, idx):
+        return jnp.take(w, jnp.arange(idx.shape[1]), axis=0)
+
+    def bwd_of(branch):
+        def bwd(p, x, g):
+            _, pull = jax.vjp(branch, p, x)
+            return pull(g)
+        return bwd
+
+    def tok_full_bwd(w, idx, g):
+        _, pull = jax.vjp(lambda ww: tok_full(ww, idx), w)
+        return pull(g)[0]
+
+    def tok_shard_bwd(w, idx, lo, g):
+        _, pull = jax.vjp(lambda ww: tok_shard(ww, idx, lo), w)
+        return pull(g)[0]
+
+    def pos_bwd(w, idx, g):
+        _, pull = jax.vjp(lambda ww: pos_rows(ww, idx), w)
+        return pull(g.sum(axis=0))[0]
+
+    segs = {"attn_fwd": jax.jit(attn_branch),
+            "attn_bwd": jax.jit(bwd_of(attn_branch)),
+            "mlp_fwd": jax.jit(mlp_branch),
+            "mlp_bwd": jax.jit(bwd_of(mlp_branch)),
+            "head_fwd": jax.jit(head_branch),
+            "head_bwd": jax.jit(bwd_of(head_branch)),
+            "tok_full": jax.jit(tok_full),
+            "tok_full_bwd": jax.jit(tok_full_bwd),
+            "tok_shard": jax.jit(tok_shard),
+            "tok_shard_bwd": jax.jit(tok_shard_bwd),
+            "pos_rows": jax.jit(pos_rows),
+            "pos_bwd": jax.jit(pos_bwd)}
+    with _SEG_MU:
+        return _SEG_CACHE.setdefault(cfg, segs)
+
+
+# keyed by id(); each entry keeps a reference to its loss_fn so the id
+# can never be recycled under the cache
+_LOSSGRAD_CACHE: Dict[int, Tuple[Callable, Callable]] = {}
+
+
+def _lossgrad(loss_fn) -> Callable:
+    """jit(value_and_grad) of ``loss_fn(logits.reshape(-1, V), y.reshape(
+    -1))`` — the pipeline trainer's flattening, shared so host and mesh
+    parity cells run the identical loss executable."""
+    got = _LOSSGRAD_CACHE.get(id(loss_fn))
+    if got is None:
+        import jax
+
+        def flat_loss(logits, y):
+            v = logits.shape[-1]
+            return loss_fn(logits.reshape(-1, v), y.reshape(-1))
+
+        got = (loss_fn, jax.jit(jax.value_and_grad(flat_loss)))
+        _LOSSGRAD_CACHE[id(loss_fn)] = got
+    return got[1]
+
+
+# ---------------------------------------------------------------------------
+# the per-rank engine
+# ---------------------------------------------------------------------------
+
+class _TPEngine:
+    """One tp rank's eager engine over a contiguous block span of a
+    :class:`~tpu_dist.models.TransformerLM` (optionally with the
+    embedding front / lm-head tail — the full model when ``lo=0, hi=
+    depth, embed=head=True``; a pipeline stage otherwise).
+
+    Forward: np activations between jitted per-branch segments; partial
+    sums combine through the port immediately.  Backward: recompute +
+    per-segment ``jax.vjp``; activation cotangents combine immediately
+    (upstream needs them), the small norm-parameter partials are pooled
+    and combined once per backward.  All port calls happen in identical
+    program order on every rank of the gang — the lockstep contract."""
+
+    def __init__(self, model, rules, port, *, lo: int = 0,
+                 hi: Optional[int] = None, embed: bool = True,
+                 head: bool = True, loss_fn=None):
+        from ..nn.layers import RMSNorm
+        if getattr(model, "num_experts", 0):
+            raise TPConfigError("host tp engine supports dense "
+                                "TransformerLM models only (MoE expert "
+                                "banks ride gspmd.MOE_EP_RULES)")
+        if getattr(model, "sequence_axis", None) is not None:
+            raise TPConfigError("host tp composes with host pipeline/dp, "
+                                "not mesh sequence parallelism — build "
+                                "the model without sequence_axis")
+        rules = DEFAULT_RULES if rules is None else rules
+        tp = port.world
+        for ax, m in rules.items():
+            if m == "model" and ax not in _HOST_SHARDABLE:
+                raise TPConfigError(
+                    f"host tp engine cannot shard logical axis {ax!r}; "
+                    f"supported: {_HOST_SHARDABLE}")
+        self.axes = model_axes(model)
+        self.port = port
+        self.tp = tp
+        self.heads_sharded = tp > 1 and rules.get("heads") == "model"
+        self.mlp_sharded = tp > 1 and rules.get("mlp") == "model"
+        self.vocab_sharded = tp > 1 and rules.get("vocab") == "model"
+        for flag, ax in ((self.heads_sharded, "heads"),
+                         (self.mlp_sharded, "mlp"),
+                         (self.vocab_sharded, "vocab")):
+            if flag and self.axes[ax] % tp:
+                raise TPConfigError(
+                    f"logical axis {ax!r} of size {self.axes[ax]} not "
+                    f"divisible by tp={tp}")
+        self.lo = lo
+        self.hi = model.depth if hi is None else hi
+        self.embed = embed
+        self.head = head
+        self.has_pos = model.pos is not None
+        attn = model.block0.attn
+        heads_local = (self.axes["heads"] // tp if self.heads_sharded
+                       else self.axes["heads"])
+        self.cfg = _SegCfg(
+            norm="rmsnorm" if isinstance(model.ln_f, RMSNorm)
+            else "layernorm",
+            block_eps=float(model.block0.ln1.eps),
+            final_eps=float(model.ln_f.eps),
+            heads=heads_local, head_dim=int(attn.head_dim),
+            rope=bool(attn.rope), rope_theta=float(attn.rope_theta),
+            causal=bool(attn.causal))
+        self.seg = _segments(self.cfg)
+        self._lossgrad = _lossgrad(loss_fn) if loss_fn is not None else None
+        self._vloc = (self.axes["vocab"] // tp if self.vocab_sharded
+                      else self.axes["vocab"])
+
+    # -- segment param views (local leaves, original key layout) ----------
+
+    def _attn_p(self, params, i):
+        p = params[f"block{i}.attn"]
+        d = {"ln": params[f"block{i}.ln1"], "qkv_w": p["qkv_weight"],
+             "out_w": p["out_weight"]}
+        if "qkv_bias" in p:
+            d["qkv_b"] = p["qkv_bias"]
+        return d
+
+    def _mlp_p(self, params, i):
+        up, down = params[f"block{i}.mlp.0"], params[f"block{i}.mlp.2"]
+        d = {"ln": params[f"block{i}.ln2"], "w0": up["weight"],
+             "w2": down["weight"]}
+        if "bias" in up:
+            d["b0"] = up["bias"]
+        return d
+
+    def _head_p(self, params):
+        p = params["head"]
+        d = {"ln": params["ln_f"], "w": p["weight"]}
+        if "bias" in p:
+            d["b"] = p["bias"]
+        return d
+
+    # -- forward ----------------------------------------------------------
+
+    def _run(self, params, x):
+        """(output, stash): output is logits (head stages) or the span's
+        activation; stash holds each branch's input for the vjp pass."""
+        st: Dict[str, object] = {"a_in": {}, "m_in": {}}
+        if self.embed:
+            idx = np.asarray(x)
+            st["idx"] = idx
+            wtok = params["tok"]["weight"]
+            if self.vocab_sharded:
+                lo_row = self.port.rank * self._vloc
+                part = np.asarray(self.seg["tok_shard"](wtok, idx, lo_row))
+                h = self.port.all_reduce(part)
+            else:
+                h = np.asarray(self.seg["tok_full"](wtok, idx))
+            if self.has_pos:
+                h = h + np.asarray(self.seg["pos_rows"](
+                    params["pos"]["weight"], idx))
+        else:
+            h = np.asarray(x)
+        for i in range(self.lo, self.hi):
+            st["a_in"][i] = h
+            part = np.asarray(self.seg["attn_fwd"](self._attn_p(params, i),
+                                                   h))
+            comb = self.port.all_reduce(part) if self.heads_sharded \
+                else part
+            ob = params[f"block{i}.attn"].get("out_bias")
+            if ob is not None:
+                comb = comb + np.asarray(ob)
+            h = st["a_in"][i] + comb
+            st["m_in"][i] = h
+            part = np.asarray(self.seg["mlp_fwd"](self._mlp_p(params, i),
+                                                  h))
+            comb = self.port.all_reduce(part) if self.mlp_sharded else part
+            b2 = params[f"block{i}.mlp.2"].get("bias")
+            if b2 is not None:
+                comb = comb + np.asarray(b2)
+            h = st["m_in"][i] + comb
+        if self.head:
+            st["h_in"] = h
+            z = np.asarray(self.seg["head_fwd"](self._head_p(params), h))
+            out = self.port.all_gather_last(z) if self.vocab_sharded else z
+            return out, st
+        return h, st
+
+    def forward(self, params, x):
+        return self._run(params, x)[0]
+
+    def loss(self, params, x, y):
+        logits, _ = self._run(params, x)
+        val, _ = self._lossgrad(logits, np.asarray(y))
+        return float(val)
+
+    # -- backward (recompute + per-segment vjp) ---------------------------
+
+    def backward(self, params, x, gy, *, from_loss: bool):
+        """(loss_or_None, grads, dx_or_None).  ``gy`` is the target batch
+        under ``from_loss`` (last stage), the output cotangent otherwise.
+        ``dx`` is None on embedding stages (nothing upstream)."""
+        out, st = self._run(params, x)
+        loss = None
+        if from_loss:
+            val, dlogits = self._lossgrad(out, np.asarray(gy))
+            loss = float(val)
+            g = np.asarray(dlogits)
+        else:
+            g = np.asarray(gy)
+        grads: Dict[str, Dict[str, np.ndarray]] = {}
+        pool: Dict[str, Dict[str, np.ndarray]] = {}
+
+        def norm_grad(path, d_ln, partial):
+            got = {k: np.asarray(v) for k, v in d_ln.items()}
+            (pool if partial else grads)[path] = got
+
+        if self.head:
+            if self.vocab_sharded:
+                lo_col = self.port.rank * self._vloc
+                gloc = np.ascontiguousarray(
+                    g[..., lo_col:lo_col + self._vloc])
+            else:
+                gloc = g
+            dp, dxp = self.seg["head_bwd"](self._head_p(params),
+                                           st["h_in"], gloc)
+            grads["head"] = {"weight": np.asarray(dp["w"])}
+            if "b" in dp:
+                grads["head"]["bias"] = np.asarray(dp["b"])
+            norm_grad("ln_f", dp["ln"], self.vocab_sharded)
+            dxp = np.asarray(dxp)
+            g = self.port.all_reduce(dxp) if self.vocab_sharded else dxp
+        for i in reversed(range(self.lo, self.hi)):
+            down_path = f"block{i}.mlp.2"
+            b2 = params[down_path].get("bias")
+            dp, dxp = self.seg["mlp_bwd"](self._mlp_p(params, i),
+                                          st["m_in"][i], g)
+            grads[f"block{i}.mlp.0"] = {"weight": np.asarray(dp["w0"])}
+            if "b0" in dp:
+                grads[f"block{i}.mlp.0"]["bias"] = np.asarray(dp["b0"])
+            grads[down_path] = {"weight": np.asarray(dp["w2"])}
+            if b2 is not None:
+                # row-parallel bias added post-combine on a replicated
+                # cotangent: its grad is exact on every rank, no combine
+                grads[down_path]["bias"] = g.sum(axis=(0, 1))
+            norm_grad(f"block{i}.ln2", dp["ln"], self.mlp_sharded)
+            dxc = np.asarray(dxp)
+            if self.mlp_sharded:
+                dxc = self.port.all_reduce(dxc)
+            g = g + dxc
+            attn_path = f"block{i}.attn"
+            ob = params[attn_path].get("out_bias")
+            dp, dxp = self.seg["attn_bwd"](self._attn_p(params, i),
+                                           st["a_in"][i], g)
+            grads[attn_path] = {"qkv_weight": np.asarray(dp["qkv_w"]),
+                                "out_weight": np.asarray(dp["out_w"])}
+            if "qkv_b" in dp:
+                grads[attn_path]["qkv_bias"] = np.asarray(dp["qkv_b"])
+            if ob is not None:
+                grads[attn_path]["out_bias"] = g.sum(axis=(0, 1))
+            norm_grad(f"block{i}.ln1", dp["ln"], self.heads_sharded)
+            dxc = np.asarray(dxp)
+            if self.heads_sharded:
+                dxc = self.port.all_reduce(dxc)
+            g = g + dxc
+        dx = g
+        if self.embed:
+            idx, wtok = st["idx"], params["tok"]["weight"]
+            if self.vocab_sharded:
+                lo_row = self.port.rank * self._vloc
+                grads["tok"] = {"weight": np.asarray(
+                    self.seg["tok_shard_bwd"](wtok, idx, lo_row, g))}
+            else:
+                grads["tok"] = {"weight": np.asarray(
+                    self.seg["tok_full_bwd"](wtok, idx, g))}
+            if self.has_pos:
+                grads["pos"] = {"weight": np.asarray(
+                    self.seg["pos_bwd"](params["pos"]["weight"], idx, g))}
+            dx = None
+        if pool:
+            # one deferred combine for all partial norm grads: they do not
+            # gate any other backward work, so batching them keeps the
+            # gang's small-message count flat in depth
+            grads.update(self.port.tree_all_reduce(pool))
+        return loss, grads, dx
+
+
+# ---------------------------------------------------------------------------
+# trainers
+# ---------------------------------------------------------------------------
+
+def _scale_tree(tree, factor: float):
+    import jax
+    return jax.tree.map(
+        lambda a: np.asarray(a) * np.asarray(factor, np.asarray(a).dtype),
+        tree)
+
+
+def _sum_trees(trees):
+    """Rank-order fold across dp lanes (lane 0 + lane 1 + ...)."""
+    import jax
+    acc = jax.tree.map(lambda a: np.array(a), trees[0])
+    for t in trees[1:]:
+        acc = jax.tree.map(lambda a, b: a + np.asarray(b), acc, t)
+    return acc
+
+
+def _np_params(tree):
+    import jax
+    return jax.tree.map(np.asarray, tree)
+
+
+class TPTrainer:
+    """dp×tp host-path training over one flat world: ranks ``[d*tp + t]``,
+    tp gangs contiguous.  Every rank builds ALL tp groups then ALL dp
+    groups in identical program order (the ``new_group`` contract,
+    tpudlint TD008), keeps the rule-table shard of the replicated-init
+    params, and steps with rule-driven partial-sum combines over its tp
+    gang plus bucketed gradient sums over its dp gang (summed, then
+    scaled by 1/dp on host — at dp=2 bitwise equal to the serial oracle's
+    rank-order fold).
+
+    ``step(x, y)``: all tp ranks of a lane feed the SAME microbatch (the
+    lane's dp shard); returns the lane's loss.  Changing only ``rules``
+    re-partitions the whole run — ``{}``/all-None falls back to pure dp
+    with fully replicated params."""
+
+    def __init__(self, model, optimizer, loss_fn, *, dp, tp: int = 1,
+                 rules=None, grad_sync: str = "bucket",
+                 bucket_bytes: Optional[int] = None, seed: int = 0,
+                 timeout: float = 120.0, tp_group=None, dp_group=None):
+        import jax
+        from ..collectives.topology import new_group
+        if grad_sync not in ("bucket", "none"):
+            raise TPConfigError(f"unknown grad_sync {grad_sync!r}")
+        world, rank = dp.num_processes, dp.rank
+        if tp < 1 or world % tp:
+            raise TPConfigError(
+                f"world {world} not divisible by tp={tp}")
+        self.rules = DEFAULT_RULES if rules is None else rules
+        self.optimizer = optimizer
+        self.dp_size = world // tp
+        self.tp = tp
+        self.dp_idx, self.tp_idx = divmod(rank, tp)
+        self.timeout = float(timeout)
+        if tp_group is None or dp_group is None:
+            class _Parent:
+                pass
+
+            parent = _Parent()
+            parent.rank, parent.num_processes = rank, world
+            # identical program order on EVERY rank: all tp gangs, then
+            # all dp gangs — group ids derive from (members, creation
+            # index), so any divergence splits the gangs apart loudly.
+            # NOTE in-process rigs (threads sharing new_group's process-
+            # global creation counters) must instead pass pre-built
+            # ``SubGroup(members, rank, world, instance=0)`` objects.
+            tp_groups = [new_group([d * tp + t for t in range(tp)],
+                                   group=parent)
+                         for d in range(self.dp_size)]
+            dp_groups = [new_group([d * tp + t
+                                    for d in range(self.dp_size)],
+                                   group=parent)
+                         for t in range(tp)]
+            tp_group = tp_groups[self.dp_idx]
+            dp_group = dp_groups[self.tp_idx]
+        self.tp_group = tp_group
+        self.dp_group = dp_group
+        self.port = PlaneCombiner(self.tp_group, dp, timeout=timeout)
+        self.engine = _TPEngine(model, self.rules, self.port,
+                                loss_fn=loss_fn)
+        full = _np_params(model.init(jax.random.PRNGKey(seed)))
+        self.params = tp_shard_params(model, full, self.tp_idx, tp,
+                                      self.rules)
+        self.opt_state = optimizer.init(self.params)
+        self._bucketer = None
+        if self.dp_size > 1 and grad_sync == "bucket":
+            from ..collectives.bucketer import Bucketer
+            self._bucketer = Bucketer(bucket_bytes,
+                                      dp=self.dp_group.view(dp))
+
+    @property
+    def tp_bytes_sent(self) -> int:
+        return self.port.bytes_sent
+
+    def step(self, x, y) -> float:
+        loss, grads, _ = self.engine.backward(self.params, x, y,
+                                              from_loss=True)
+        if self._bucketer is not None:
+            work = self._bucketer.all_reduce(grads, op="sum")
+            grads = work.wait_all(self.timeout)
+            grads = _scale_tree(grads, 1.0 / self.dp_size)
+        new_p, new_o = self.optimizer.update(grads, self.opt_state,
+                                             self.params)
+        self.params = _np_params(new_p)
+        self.opt_state = new_o
+        return loss
+
+
+class SerialTPRunner:
+    """In-process dp×tp oracle: a (dp, tp) engine grid on threads over
+    :class:`LocalCombiner` gangs — no sockets, rank-order folds
+    everywhere, so its step outputs are THE reference bytes the
+    plane-backed :class:`TPTrainer` must reproduce.  Params/optimizer
+    state are kept once per tp rank (dp lanes are exact replicas by
+    construction).  ``step`` splits the global batch over dp lanes and
+    returns the per-lane losses."""
+
+    def __init__(self, model, optimizer, loss_fn, *, tp: int = 1,
+                 dp: int = 1, rules=None, seed: int = 0):
+        import jax
+        self.rules = DEFAULT_RULES if rules is None else rules
+        self.optimizer = optimizer
+        self.tp, self.dp = int(tp), int(dp)
+        self._combiners = [LocalCombiner(tp) for _ in range(dp)]
+        self._engines = [[_TPEngine(model, self.rules,
+                                    self._combiners[d].bound(t),
+                                    loss_fn=loss_fn)
+                          for t in range(tp)] for d in range(dp)]
+        full = _np_params(model.init(jax.random.PRNGKey(seed)))
+        self.params = [tp_shard_params(model, full, t, tp, self.rules)
+                       for t in range(tp)]
+        self.opt_state = [optimizer.init(p) for p in self.params]
+
+    def step(self, x, y) -> List[float]:
+        x, y = np.asarray(x), np.asarray(y)
+        if x.shape[0] % self.dp:
+            raise TPConfigError(
+                f"batch {x.shape[0]} not divisible by dp={self.dp}")
+        xs = np.split(x, self.dp)
+        ys = np.split(y, self.dp)
+        results: Dict[Tuple[int, int], Tuple] = {}
+        errors: List[BaseException] = []
+
+        def run(d, t):
+            try:
+                results[(d, t)] = self._engines[d][t].backward(
+                    self.params[t], xs[d], ys[d], from_loss=True)
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                errors.append(e)
+
+        threads = [threading.Thread(target=run, args=(d, t), daemon=True)
+                   for d in range(self.dp) for t in range(self.tp)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        if errors:
+            raise errors[0]
+        losses = [results[(d, 0)][0] for d in range(self.dp)]
+        for t in range(self.tp):
+            lanes = [results[(d, t)][1] for d in range(self.dp)]
+            grads = lanes[0] if self.dp == 1 else _scale_tree(
+                _sum_trees(lanes), 1.0 / self.dp)
+            new_p, new_o = self.optimizer.update(grads, self.opt_state[t],
+                                                 self.params[t])
+            self.params[t] = _np_params(new_p)
+            self.opt_state[t] = new_o
+        return losses
+
+
+# ---------------------------------------------------------------------------
+# pipeline composition (dp×tp×pp)
+# ---------------------------------------------------------------------------
+
+def build_tp_stage_fns(part, stage: int, loss_fn, port, rules=None):
+    """Tensor-parallel :class:`~tpu_dist.pipeline.stage.StageFns` over
+    ``part.spans[stage]`` of a
+    :class:`~tpu_dist.pipeline.partition.TransformerPartition` — drop-in
+    for ``pipeline.PipelineStage(fns=...)``, so a (pp stage × tp rank)
+    grid runs 3D dp×tp×pp training entirely on the host path.
+
+    Every tp peer of a stage runs the same pipeline schedule, hence
+    issues the same combiner sequence per F/B op — the recompute inside
+    ``bwd`` re-fires its forward combines in lockstep too.  Params are
+    this tp rank's shard (:func:`tp_shard_params`) of
+    ``part.stage_params(...)``."""
+    from ..pipeline.stage import StageFns
+    lo, hi = part.spans[stage]
+    engine = _TPEngine(part.model, rules, port, lo=lo, hi=hi,
+                       embed=part.is_first(stage),
+                       head=part.is_last(stage), loss_fn=loss_fn)
+    first, last = part.is_first(stage), part.is_last(stage)
+    return StageFns(
+        fwd=None if last else (lambda p, x: engine.forward(p, x)),
+        fwd_loss=(lambda p, x, y: engine.loss(p, x, y)) if last else None,
+        bwd=None if last else (
+            lambda p, x, g: engine.backward(p, x, g, from_loss=False)[1:]),
+        bwd_loss=(lambda p, x, y:
+                  engine.backward(p, x, y, from_loss=True)[1:])
+        if last else None)

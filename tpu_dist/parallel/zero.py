@@ -212,7 +212,11 @@ class ZeroOptimizer:
 
     def _build_plan(self, params, group) -> _Plan:
         import jax
-        from ..collectives.ring import _bounds
+        # per-leaf shard spans come from the unified rule plane's flat
+        # chunk contract (parallel/rules.py -> ring._bounds), shared with
+        # the ring reduce-scatter and reshard manifests — existing
+        # sharded checkpoints stay bitwise-compatible by construction
+        from .rules import chunk_bounds as _bounds
         group, n, r = self._resolve(group)
         leaves, treedef = jax.tree.flatten(params)
         infos = []
@@ -447,8 +451,8 @@ class ZeroOptimizer:
         this rank's updated shard IS bucket chunk ``rank`` — it drops in
         without reshuffling, and unpacking inverts the layout."""
         from ..collectives import eager as _eager
-        from ..collectives.ring import _bounds
         from ..collectives.work import completed_work, engine_for
+        from .rules import chunk_bounds as _bounds
 
         n, r = plan.world, plan.rank
         pinned = self._dp is not None

@@ -86,8 +86,11 @@ def _groups(leaf_dtypes: Sequence[str]) -> List[Tuple[str, List[int]]]:
 
 
 def _bounds(n_elems: int, n: int):
-    from ..collectives.ring import _bounds as rb
-    return rb(int(n_elems), int(n))
+    # the unified rule plane's flat chunk contract (parallel/rules.py),
+    # itself pinned to ring._bounds — ZeRO shards, the ring
+    # reduce-scatter, and these manifests all cut the same spans
+    from ..parallel.rules import chunk_bounds
+    return chunk_bounds(int(n_elems), int(n))
 
 
 def _span_len(size: int, world: int, rank: int) -> int:

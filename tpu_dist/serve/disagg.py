@@ -148,13 +148,13 @@ class DisaggSlotEngine(SlotEngine):
                  max_len: Optional[int] = None, cache_dtype=None,
                  min_bucket: int = 16, kv_timeout: Optional[float] = None,
                  rank: Optional[int] = None, role_rank: int = 0):
-        import jax.numpy as jnp
-        if cache_dtype is not None and jnp.dtype(cache_dtype) == jnp.int8:
-            raise DisaggError(
-                "disaggregated decode does not support the int8 slot "
-                "cache: transferred rows carry no k/v scales — run the "
-                "decode pool in float (the KV WIRE can still be "
-                "int8_block)")
+        # int8 slot caches work end-to-end: the prefill worker runs its
+        # forward with the same cache dtype, so the transferred rows
+        # carry the int8 k/v AND their f32 per-(token, head) scales as
+        # ordinary fragments (kv_template lists every non-index key) —
+        # staging pads and write_slot_rows scatters them like any other
+        # row.  Both endpoints must agree on the dtype (the template's
+        # geometry check names a mismatch).
         super().__init__(model, params, num_slots=num_slots,
                          max_len=max_len, cache_dtype=cache_dtype,
                          min_bucket=min_bucket)

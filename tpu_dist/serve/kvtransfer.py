@@ -24,11 +24,15 @@ reshard engine's fragment tags):
   shape ``(1, length, heads, head_dim)``, in deterministic (sorted
   path, sorted key) order on both sides.
 
-``wire="int8_blockN"`` opts each fragment into the block-quantized int8
-wire from the collectives layer (PR 8): ~3.9x fewer bytes, but LOSSY —
-the restored rows are not bit-identical to the computed ones, so token
-parity with offline ``generate()`` no longer holds and the smoke gate
-excludes it (same opt-in contract as the sharded partial-sum wire).
+``wire="int8_blockN"`` opts each FLOAT fragment into the block-quantized
+int8 wire from the collectives layer (PR 8): ~3.9x fewer bytes, but
+LOSSY — the restored rows are not bit-identical to the computed ones, so
+token parity with offline ``generate()`` no longer holds and the smoke
+gate excludes it (same opt-in contract as the sharded partial-sum wire).
+Integer fragments — the k/v rows of an int8 SLOT cache, already
+quantized with their scales riding as separate float fragments — ship
+exact regardless of ``wire``: re-quantizing integer data would be pure
+loss, and both endpoints agree off the template's dtype.
 
 Handle discipline: ``send(..., async_op=True)`` / ``fetch(...,
 async_op=True)`` return a :class:`~tpu_dist.collectives.work.Work`
@@ -108,6 +112,18 @@ class KVTransfer:
             return f"kv/{rid}/m"
         return f"kv/{rid}/{j}.{key}"
 
+    def _quantized(self, path: str, key: str) -> bool:
+        """Whether this fragment rides the int8_block wire: FLOAT
+        fragments only.  An int8-slot-cache row's k/v are ALREADY int8
+        with their scales travelling as separate (float, hence
+        block-quantized) fragments — re-quantizing integer data would
+        be pure loss.  Both endpoints evaluate this off the template's
+        dtype, so the frame encodings agree without negotiation."""
+        if self.wire is None:
+            return False
+        _, dtype = self.template[path][key]
+        return np.issubdtype(dtype, np.floating)
+
     # -- prefill side ---------------------------------------------------------
 
     def send(self, dst: int, rid: int, rows, length: int, first_tok: int,
@@ -139,18 +155,15 @@ class KVTransfer:
         meta = np.asarray([length, int(first_tok), int(prefix_hit),
                            int(prefill_ns), len(frags)], np.int64)
         sent = self.dp.send_array(dst, self._tag(rid), meta)
-        if self.wire is None:
-            for j, ((path, key), arr) in enumerate(zip(self._frames,
-                                                       frags)):
-                sent += self.dp.send_array(dst, self._tag(rid, j, key), arr)
-        else:
-            from ..collectives.quant import QuantChunk, quantize
-            for j, ((path, key), arr) in enumerate(zip(self._frames,
-                                                       frags)):
+        for j, ((path, key), arr) in enumerate(zip(self._frames, frags)):
+            if self._quantized(path, key):
+                from ..collectives.quant import QuantChunk, quantize
                 q, scales = quantize(arr.reshape(-1), self.wire)
                 sent += self.dp.send_quant(
                     dst, self._tag(rid, j, key),
                     QuantChunk(q, scales, self.wire))
+            else:
+                sent += self.dp.send_array(dst, self._tag(rid, j, key), arr)
         self.sent_bytes += int(sent)
         return int(sent)
 
@@ -207,7 +220,7 @@ class KVTransfer:
         for j, (path, key) in enumerate(self._frames):
             shape, dtype = self.template[path][key]
             got = recv(self._tag(rid, j, key))
-            if self.wire is not None:
+            if self._quantized(path, key):
                 nbytes += int(got.nbytes)
                 got = got.dequantize(np.float32).astype(dtype, copy=False)
             else:

@@ -88,6 +88,27 @@ def _probe_interval() -> float:
 _EXCHANGE_MAX_BYTES = 128 << 10
 
 
+def _tp_span(op: str, value, group: str):
+    """Obs span for one tp combine, stamped with ``group=`` so
+    ``obs diagnose`` attributes tensor-parallel traffic to the shard gang
+    instead of the world's lockstep sequence (the body then stamps
+    ``algo=`` — exchange vs ring — via note_algo)."""
+    try:
+        from ..obs.hooks import collective_span
+    except Exception:
+        import contextlib
+        return contextlib.nullcontext()
+    return collective_span(op, value=value, reduce_op="sum", group=group)
+
+
+def _note_algo(algo: str) -> None:
+    try:
+        from ..obs.hooks import note_algo
+        note_algo(algo)
+    except Exception:
+        pass
+
+
 def _exchange_all_reduce(dp, arr, tag: str, timeout: float):
     """Direct-exchange SUM: one one-way latency instead of the ring's
     2(N-1) sequential hops.  Fold order is RANK order on every rank, so
@@ -121,83 +142,34 @@ class ShardPlanError(ServeError):
 # parameter sharding: span math shared by in-memory slicing and range-reads
 # ---------------------------------------------------------------------------
 
-# leaf-slicing tags per (module-kind, param name); every tag maps to ONE
-# span function, so shard_params (in-memory) and ShardedParams
-# .from_checkpoint (npz range-reads) assemble bit-identical shards by
-# construction
-_ATTN_RE = re.compile(r"^block(\d+)\.attn$")
-_MLP_UP_RE = re.compile(r"^block(\d+)\.mlp\.0$")
-_MLP_DOWN_RE = re.compile(r"^block(\d+)\.mlp\.2$")
+# Span math now lives in the unified rule plane (parallel/rules.py):
+# SERVING_RULES binds heads + MLP hidden to the shard gang, and
+# spans_for() generalizes the old per-tag helpers (qkv_w/qkv_b/head_rows/
+# rows/cols/vec/bias0) — golden-pinned bitwise against the pre-refactor
+# layouts in tests/test_rules.py, so existing sharded checkpoints load
+# unchanged.  Every span stays contiguous, which is what lets
+# ShardedParams range-read them straight out of a checkpoint's
+# ``arrays.npz`` (the reshard fragment discipline).
 
 
-def _leaf_tag(path: str, name: str) -> str:
-    """How parameter ``{path: {name: ...}}`` shards across the group."""
-    if _ATTN_RE.match(path):
-        return {"qkv_weight": "qkv_w", "qkv_bias": "qkv_b",
-                "out_weight": "head_rows", "out_bias": "bias0"}[name]
-    if _MLP_UP_RE.match(path):
-        return {"weight": "cols", "bias": "vec"}[name]
-    if _MLP_DOWN_RE.match(path):
-        return {"weight": "rows", "bias": "bias0"}[name]
-    return "full"
-
-
-def _leaf_spans(tag: str, shape: Tuple[int, ...], dims: dict,
-                rank: int, world: int):
+def _leaf_plan(path: str, name: str, shape: Tuple[int, ...], dims: dict,
+               rank: int, world: int):
     """``(flat element spans, out_shape)`` of shard ``rank``'s slice of a
     leaf with flat C-order layout ``shape`` — or ``None`` when this shard
     drops the leaf entirely (the partial-sum bias convention: exactly one
     shard carries each row-split projection's bias, so the post-all-reduce
-    sum adds it once).  Every span is contiguous, which is what lets
-    :class:`ShardedParams` range-read them straight out of a checkpoint's
-    ``arrays.npz`` (the reshard fragment discipline)."""
-    H, hd = dims["num_heads"], dims["head_dim"]
-    nl = H // world                      # heads per shard
-    hidden = dims["hidden"]
-    hl = hidden // world                 # MLP hidden columns per shard
-    h0 = rank * nl
-    c0 = rank * hl
-    if tag == "full":
-        n = int(np.prod(shape, dtype=np.int64))
-        return [(0, n)], shape
-    if tag == "bias0":
-        if rank != 0:
-            return None
-        n = int(np.prod(shape, dtype=np.int64))
-        return [(0, n)], shape
-    if tag == "qkv_w":
-        # (dim, 3*dim) with columns laid out [3][H][hd]: per (row, c) one
-        # contiguous block of nl*hd elements
-        dim, three_dim = shape
-        spans = []
-        for i in range(dim):
-            for c in range(3):
-                base = i * three_dim + (c * H + h0) * hd
-                spans.append((base, base + nl * hd))
-        return spans, (dim, 3 * nl * hd)
-    if tag == "qkv_b":
-        spans = []
-        for c in range(3):
-            base = (c * H + h0) * hd
-            spans.append((base, base + nl * hd))
-        return spans, (3 * nl * hd,)
-    if tag == "head_rows":
-        # out_weight (dim, dim): input rows are the head concat — this
-        # shard's heads are rows [h0*hd, (h0+nl)*hd), ONE contiguous span
-        rows, cols = shape
-        return [(h0 * hd * cols, (h0 + nl) * hd * cols)], (nl * hd, cols)
-    if tag == "rows":
-        # mlp down-projection (hidden, dim): row-split by hidden columns
-        rows, cols = shape
-        return [(c0 * cols, (c0 + hl) * cols)], (hl, cols)
-    if tag == "cols":
-        # mlp up-projection (dim, hidden): column-split — per row one span
-        rows, cols = shape
-        return ([(i * cols + c0, i * cols + c0 + hl) for i in range(rows)],
-                (rows, hl))
-    if tag == "vec":
-        return [(c0, c0 + hl)], (hl,)
-    raise ShardConfigError(f"unknown shard tag {tag!r}")
+    sum adds it once)."""
+    from ..parallel import rules as _shard_rules
+    axes = {"qkv3": 3, "heads": dims["num_heads"],
+            "head_dim": dims["head_dim"], "mlp": dims["hidden"],
+            "embed": dims["dim"], "vocab": dims["vocab"]}
+    try:
+        return _shard_rules.spans_for(
+            path, name, shape, axes, rank, world,
+            rules=_shard_rules.SERVING_RULES, mesh_axis="shard",
+            partial="first")
+    except _shard_rules.ShardLayoutError as e:
+        raise ShardConfigError(str(e)) from e
 
 
 def _model_dims(model) -> dict:
@@ -251,8 +223,8 @@ def shard_params(model, params, shard_rank: int, shard_world: int) -> dict:
         sliced = {}
         for name, arr in leaf_dict.items():
             arr = np.asarray(arr)
-            plan = _leaf_spans(_leaf_tag(path, name), arr.shape, dims,
-                               shard_rank, shard_world)
+            plan = _leaf_plan(path, name, arr.shape, dims,
+                              shard_rank, shard_world)
             if plan is None:
                 continue
             spans, out_shape = plan
@@ -305,8 +277,8 @@ class ShardedParams:
                 path, name = m.group(1), m.group(2)
                 shape = tuple(spec["shape"])
                 dtype = np.dtype(spec["dtype"])
-                plan = _leaf_spans(_leaf_tag(path, name), shape, dims,
-                                   shard_rank, shard_world)
+                plan = _leaf_plan(path, name, shape, dims,
+                                  shard_rank, shard_world)
                 if plan is None:
                     continue
                 spans, out_shape = plan
@@ -558,22 +530,27 @@ class ShardedDecoder:
             return completed_work(arr, label="shard-ar")
         seq = self._seq
         self._seq += 1
+        grp = f"shard:w{self.world}"
         if self.comm_dtype is None and arr.nbytes <= _EXCHANGE_MAX_BYTES:
+            def run_exchange():
+                with _tp_span("shard_all_reduce", arr, grp):
+                    _note_algo("exchange")
+                    return _exchange_all_reduce(self.dp, arr, f"sx{seq}",
+                                                self.ar_timeout)
             if not async_op:
-                return _exchange_all_reduce(self.dp, arr, f"sx{seq}",
-                                            self.ar_timeout)
+                return run_exchange()
             from ..collectives.work import engine_for
-            return engine_for(self.dp).submit(
-                lambda: _exchange_all_reduce(self.dp, arr, f"sx{seq}",
-                                             self.ar_timeout),
-                label=f"shard-ar{seq}")
+            return engine_for(self.dp).submit(run_exchange,
+                                              label=f"shard-ar{seq}")
         from ..collectives.ring import ring_all_reduce
         from ..collectives.work import engine_for
 
         def run():
-            return ring_all_reduce(self.dp, arr, op="sum",
-                                   tag=f"sd{seq}",
-                                   comm_dtype=self.comm_dtype)
+            with _tp_span("shard_all_reduce", arr, grp):
+                _note_algo("ring")
+                return ring_all_reduce(self.dp, arr, op="sum",
+                                       tag=f"sd{seq}",
+                                       comm_dtype=self.comm_dtype)
         if async_op:
             return engine_for(self.dp).submit(run, label=f"shard-ar{seq}")
         work = engine_for(self.dp).submit(run, label=f"shard-ar{seq}")
